@@ -1,0 +1,39 @@
+"""reservoir-tpu: a TPU-native reservoir-sampling framework.
+
+A from-scratch rebuild of the capabilities of NthPortal/reservoir
+(single-pass uniform sampling via Algorithm L, distinct-value sampling via
+salted bottom-k hashing, single-use/reusable lifecycles, and a pass-through
+stream operator materializing the final sample) designed for JAX/XLA/Pallas:
+reservoir state is a pure pytree, the hot path is a vmapped batched kernel
+over tens of thousands of independent reservoirs, RNG is counter-based
+(reproducible by construction), and multi-chip scale goes through
+``jax.sharding`` meshes.
+
+Layers (bottom-up; compare SURVEY.md §1):
+
+- :mod:`reservoir_tpu.oracle`   — CPU semantic oracles (the reference behavior)
+- :mod:`reservoir_tpu.ops`      — device kernels (jit/vmap + Pallas)
+- :mod:`reservoir_tpu.api`      — Sampler API with the reference's lifecycle
+- :mod:`reservoir_tpu.parallel` — mesh sharding, collectives, reservoir merge
+- :mod:`reservoir_tpu.stream`   — pass-through stream operator + host bridge
+- :mod:`reservoir_tpu.utils`    — checkpoint, metrics, tracing
+"""
+
+from .config import (
+    DEFAULT_INITIAL_SIZE,
+    MAX_SIZE,
+    SamplerConfig,
+)
+from .errors import AbruptStreamTermination, SamplerClosedError, StreamCancelled
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "MAX_SIZE",
+    "DEFAULT_INITIAL_SIZE",
+    "SamplerConfig",
+    "SamplerClosedError",
+    "AbruptStreamTermination",
+    "StreamCancelled",
+    "__version__",
+]

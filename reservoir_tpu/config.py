@@ -1,0 +1,125 @@
+"""Configuration and parameter validation.
+
+The reference's entire configuration surface is constructor parameters,
+validated eagerly (``Sampler.scala:70-95``):
+
+- ``MaxSize = Int.MaxValue - 2``            (``Sampler.scala:71``)
+- ``DefaultInitialSize = 16``               (``Sampler.scala:72``)
+- ``validateSharedParams``: ``0 < maxSampleSize <= MaxSize`` else
+  ``IllegalArgumentException``; non-null ``map`` else NPE (``Sampler.scala:79-86``)
+- ``validateDistinctParams`` additionally requires a ``hash`` (``Sampler.scala:92-95``)
+
+We keep the same philosophy — no global flag registry, no config files.  A
+frozen :class:`SamplerConfig` carries the device-engine parameters (reservoir
+count, tile size, dtypes, mesh axes); plain keyword arguments configure the
+host :class:`~reservoir_tpu.api.Sampler`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Optional
+
+#: Maximum sample size, identical to the reference (``Sampler.scala:71``).
+MAX_SIZE: int = 2**31 - 3  # Int.MaxValue - 2 == 2147483645
+
+#: Initial capacity of a non-pre-allocated growable reservoir
+#: (``Sampler.scala:72``).  The host oracle grows a Python list (already
+#: geometric); device reservoirs are always statically shaped at ``k`` —
+#: XLA requires static shapes, so ``pre_allocate`` is the natural mode there.
+DEFAULT_INITIAL_SIZE: int = 16
+
+
+def validate_max_sample_size(max_sample_size: Any) -> int:
+    """``0 < maxSampleSize <= MaxSize`` (``Sampler.scala:79-84``)."""
+    if not isinstance(max_sample_size, int) or isinstance(max_sample_size, bool):
+        raise ValueError(
+            f"max_sample_size must be an int, got {type(max_sample_size).__name__}"
+        )
+    if max_sample_size <= 0:
+        raise ValueError(f"max_sample_size must be positive, got {max_sample_size}")
+    if max_sample_size > MAX_SIZE:
+        raise ValueError(
+            f"max_sample_size must be <= {MAX_SIZE}, got {max_sample_size}"
+        )
+    return max_sample_size
+
+
+def validate_map(map_fn: Any) -> Callable:
+    """Non-null, callable ``map`` (``Sampler.scala:85`` — NPE -> TypeError)."""
+    if map_fn is None or not callable(map_fn):
+        raise TypeError("map function must be callable (got %r)" % (map_fn,))
+    return map_fn
+
+
+def validate_hash(hash_fn: Any) -> Callable:
+    """Non-null, callable ``hash`` (``Sampler.scala:92-95``)."""
+    if hash_fn is None or not callable(hash_fn):
+        raise TypeError("hash function must be callable (got %r)" % (hash_fn,))
+    return hash_fn
+
+
+def validate_shared_params(max_sample_size: Any, map_fn: Any) -> None:
+    """Mirror of ``validateSharedParams`` (``Sampler.scala:79-86``)."""
+    validate_max_sample_size(max_sample_size)
+    validate_map(map_fn)
+
+
+def validate_non_distinct_params(max_sample_size: Any, map_fn: Any) -> None:
+    """Mirror of ``validateNonDistinctParams`` (``Sampler.scala:87-90``)."""
+    validate_shared_params(max_sample_size, map_fn)
+
+
+def validate_distinct_params(max_sample_size: Any, map_fn: Any, hash_fn: Any) -> None:
+    """Mirror of ``validateDistinctParams`` (``Sampler.scala:92-95``)."""
+    validate_shared_params(max_sample_size, map_fn)
+    validate_hash(hash_fn)
+
+
+@dataclasses.dataclass(frozen=True)
+class SamplerConfig:
+    """Frozen device-engine configuration.
+
+    One logical "sampler" on device is ``num_reservoirs`` independent
+    reservoirs updated in lockstep (the reference's single mutable sampler,
+    ``Sampler.scala:196-207``, becomes a pytree of ``[R, ...]`` arrays).
+
+    Attributes:
+      max_sample_size: ``k`` — reservoir capacity per stream.
+      num_reservoirs: ``R`` — independent reservoirs (vmapped axis).
+      tile_size: ``B`` — elements consumed per reservoir per device step.
+      element_dtype: dtype of stream elements on device.
+      sample_dtype: dtype of stored samples (post-``map``); defaults to
+        ``element_dtype``.
+      count_dtype: dtype of the per-reservoir element counter.  ``int32``
+        supports 2^31-1 elements *per reservoir* (ample for sharded streams);
+        pass ``int64`` with x64 enabled for longer single streams.
+      distinct: bottom-k distinct-value mode (``Sampler.scala:383-412``).
+      weighted: A-ExpJ weighted mode (capability beyond the reference).
+      mesh_axis: mesh axis name the reservoir dimension is sharded over
+        (None = single device).
+    """
+
+    max_sample_size: int
+    num_reservoirs: int = 1
+    tile_size: int = 1024
+    element_dtype: Any = "int32"
+    sample_dtype: Optional[Any] = None
+    count_dtype: Any = "int32"
+    distinct: bool = False
+    weighted: bool = False
+    mesh_axis: Optional[str] = None
+
+    def __post_init__(self) -> None:
+        validate_max_sample_size(self.max_sample_size)
+        if self.num_reservoirs <= 0:
+            raise ValueError("num_reservoirs must be positive")
+        if self.tile_size <= 0:
+            raise ValueError("tile_size must be positive")
+
+    @property
+    def k(self) -> int:
+        return self.max_sample_size
+
+    def resolved_sample_dtype(self) -> Any:
+        return self.sample_dtype if self.sample_dtype is not None else self.element_dtype

@@ -1,0 +1,39 @@
+"""Exception types for reservoir-tpu.
+
+The reference maps failure modes onto JVM exception types
+(``core/src/main/scala/lgbt/princess/reservoir/Sampler.scala:79-95, 185-186``;
+``akka-stream/.../SampleImpl.scala:56-57``).  We mirror the *semantics* with
+idiomatic Python exception types:
+
+- ``IllegalArgumentException``  -> ``ValueError``   (invalid ``max_sample_size``)
+- ``NullPointerException``      -> ``TypeError``    (missing/non-callable ``map``/``hash``)
+- ``IllegalStateException``     -> ``SamplerClosedError``
+- ``AbruptStageTerminationException`` -> ``AbruptStreamTermination``
+"""
+
+from __future__ import annotations
+
+
+class SamplerClosedError(RuntimeError):
+    """Raised when a single-use sampler is used after ``result()``.
+
+    Mirrors the reference's ``IllegalStateException`` thrown by
+    ``SingleUse.checkOpen()`` (``Sampler.scala:185-186``).
+    """
+
+
+class AbruptStreamTermination(RuntimeError):
+    """The stream operator terminated without completing, failing or cancelling.
+
+    Mirrors ``AbruptStageTerminationException`` delivered by the reference's
+    ``postStop`` backstop (``SampleImpl.scala:56-57``): if the materialized
+    future was never completed by the normal protocol, it is failed with this.
+    """
+
+
+class StreamCancelled(RuntimeError):
+    """Downstream cancelled with a real failure (non-graceful).
+
+    Mirrors the non-``NonFailureCancellation`` branch of
+    ``onDownstreamFinish`` (``SampleImpl.scala:48-54``).
+    """

@@ -1,0 +1,1 @@
+"""Device ops: vmapped Algorithm-L, bottom-k distinct, weighted A-ExpJ, hashing."""

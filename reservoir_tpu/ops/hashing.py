@@ -1,0 +1,125 @@
+"""Salted 64-bit scrambling for distinct-value (bottom-k) sampling.
+
+The reference maps a user hash through a per-sampler-random scramble
+``byteswap64(r1 ^ byteswap64(r0 ^ hash(elem)))`` (``Sampler.scala:385-396``)
+so that the "k smallest hashes" criterion is independent of the user hash's
+structure.  We need the same property, but computable on TPU, where 64-bit
+integers are emulated and slow: the scramble here is a 6-round Feistel
+permutation over a (hi, lo) pair of uint32 limbs, with the murmur3 32-bit
+finalizer (`fmix32`) as the round function and two 64-bit salts injected
+half-way — a 64-bit keyed permutation built entirely from uint32 ops that
+vectorize on the VPU.
+
+The functions are backend-agnostic (NumPy and jax.numpy share the ufunc
+surface), so the CPU oracle and the device kernel use literally the same
+code — distinct-mode selection is integer-only and therefore *bit-identical*
+across oracle and device (unlike the float-driven Algorithm-L skip path).
+
+Collision bias: identical to the reference — two distinct values colliding in
+the 64-bit scrambled hash are treated as one (``Sampler.scala:396-408``);
+probability ~ n^2 / 2^65.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Tuple
+
+import numpy as np
+
+__all__ = [
+    "fmix32",
+    "scramble64",
+    "default_hash64",
+    "draw_salts",
+    "U32_MASK",
+]
+
+U32_MASK = 0xFFFFFFFF
+
+# Distinct odd constants injected per Feistel round (first 6 decimals of
+# well-known irrational constants, forced odd — nothing-up-my-sleeve numbers).
+_ROUND_CONSTS = (
+    0x9E3779B9,  # golden ratio
+    0x85EBCA6B,  # murmur3 c1
+    0xC2B2AE35,  # murmur3 c2
+    0x27D4EB2F,  # xxhash prime
+    0x165667B1,  # xxhash prime
+    0x9E3779B1,  # golden ratio (odd variant)
+)
+
+
+def fmix32(x):
+    """murmur3 32-bit finalizer — a full-avalanche permutation of uint32."""
+    x = x ^ (x >> np.uint32(16))
+    x = x * np.uint32(0x85EBCA6B)
+    x = x ^ (x >> np.uint32(13))
+    x = x * np.uint32(0xC2B2AE35)
+    x = x ^ (x >> np.uint32(16))
+    return x
+
+
+def scramble64(hi, lo, r0_hi, r0_lo, r1_hi, r1_lo):
+    """Keyed 64-bit permutation of ``(hi, lo)`` under salts ``r0``, ``r1``.
+
+    Plays the role of the reference's double byteswap64 scramble
+    (``Sampler.scala:396``): per-sampler salts make the ordering of scrambled
+    hashes an independent uniform random order per sampler instance.
+
+    All inputs are uint32 arrays/scalars (NumPy or jax.numpy); arithmetic is
+    modular, so the two backends agree bit-for-bit.
+    """
+    hi = hi ^ r0_hi
+    lo = lo ^ r0_lo
+    for c in _ROUND_CONSTS[:3]:
+        hi, lo = lo, hi ^ fmix32(lo + np.uint32(c))
+    hi = hi ^ r1_hi
+    lo = lo ^ r1_lo
+    for c in _ROUND_CONSTS[3:]:
+        hi, lo = lo, hi ^ fmix32(lo + np.uint32(c))
+    return hi, lo
+
+
+def default_hash64(value):
+    """Default element hash: sign-extend an int32 array to a (hi, lo) pair.
+
+    Matches the reference default ``_.hashCode().toLong`` (``Sampler.scala:75``)
+    in spirit: an identity-like embedding — all mixing is done by
+    :func:`scramble64`.  Works on NumPy and jax.numpy int32 arrays alike.
+    """
+    i32 = value.astype(np.int32)
+    lo = i32.view(np.uint32) if isinstance(i32, np.ndarray) else i32.view("uint32")
+    hi = (i32 >> np.int32(31)).view(np.uint32) if isinstance(i32, np.ndarray) else (
+        i32 >> 31
+    ).view("uint32")
+    return hi, lo
+
+
+def _split_u64(x: int) -> Tuple[int, int]:
+    x &= (1 << 64) - 1
+    return (x >> 32) & U32_MASK, x & U32_MASK
+
+
+def scramble64_int(value: int, salts: Tuple[int, int]) -> int:
+    """Scalar Python-int convenience wrapper used by the CPU oracle.
+
+    ``value`` is interpreted as a 64-bit pattern; returns the scrambled hash as
+    a Python int in ``[0, 2^64)``.  Uses uint32 NumPy scalars internally so it
+    is bit-identical to the array/device versions.
+    """
+    hi, lo = _split_u64(int(value))
+    r0_hi, r0_lo = _split_u64(salts[0])
+    r1_hi, r1_lo = _split_u64(salts[1])
+    with np.errstate(over="ignore"):
+        shi, slo = scramble64(
+            np.uint32(hi), np.uint32(lo),
+            np.uint32(r0_hi), np.uint32(r0_lo),
+            np.uint32(r1_hi), np.uint32(r1_lo),
+        )
+    return (int(shi) << 32) | int(slo)
+
+
+def draw_salts(rng: np.random.Generator) -> Tuple[int, int]:
+    """Per-instance salts, drawn once at construction (``Sampler.scala:385-388``)."""
+    return int(rng.integers(0, 1 << 64, dtype=np.uint64)), int(
+        rng.integers(0, 1 << 64, dtype=np.uint64)
+    )

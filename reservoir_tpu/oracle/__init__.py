@@ -1,0 +1,11 @@
+"""CPU semantic oracles (SURVEY §7.2 M0).
+
+Plain-Python re-derivations of the reference's two sampling engines — the
+statistical ground truth for the device kernels and the CPU baseline of
+BASELINE.md config 1.
+"""
+
+from .algorithm_l import AlgorithmLOracle
+from .bottom_k import BottomKOracle
+
+__all__ = ["AlgorithmLOracle", "BottomKOracle"]

@@ -1,0 +1,210 @@
+"""CPU semantic oracle for Algorithm-L reservoir sampling (duplicates mode).
+
+This is a from-scratch re-derivation of the *behavior* of the reference's
+``RandomElements`` engine (``Sampler.scala:196-332``) — per-element Algorithm L
+with geometric skip counts — used as the statistical oracle for the device
+kernels and as the CPU baseline (BASELINE.md config 1).  It is intentionally
+plain Python/NumPy: clarity over speed.
+
+Algorithm L ("An optimal algorithm", Li 1994; referenced by the reference at
+``Sampler.scala:227``):
+
+- fill the reservoir with the first ``k`` elements in arrival order
+  (invariant 1, ``Sampler.scala:253-255``);
+- afterwards keep a running weight ``W`` and an absolute index ``next`` of the
+  next accepted element; each acceptance overwrites a uniformly random slot
+  (invariant 2, ``Sampler.scala:243-246``) and re-draws ``W``/``next``:
+  ``W *= u1**(1/k)``; ``next += floor(log(u2)/log(1-W)) + 1``
+  (``Sampler.scala:228-236``).
+
+Elements between acceptances cost one counter bump and one compare — the bulk
+paths (:meth:`AlgorithmLOracle.sample_all`) skip them without touching them at
+all (no ``map``, no RNG), mirroring ``sampleIndexed``/``sampleIterator``
+(``Sampler.scala:261-287``).
+
+RNG is an explicit constructor input (``numpy.random.Generator``), which is the
+lesson the reference's own tests teach by counterexample: they must reach into
+private fields by reflection to force RNG state (``SamplerTest.scala:16-54``).
+Draw-order contract (shared by the per-element and bulk paths, so the
+``sample == sample_all`` invariant 4 of SURVEY §2.2 holds by construction):
+
+1. at construction: ``u1, u2`` for the initial ``W``/``next``;
+2. at each acceptance: ``slot`` (integer in ``[0, k)``), then ``u1, u2``.
+
+``W`` is tracked in log-space so that ``n ~ 1e12``-scale streams do not
+underflow (SURVEY §7.3 "Float W in log-space").
+"""
+
+from __future__ import annotations
+
+import itertools
+import math
+from typing import Any, Callable, Iterable, Iterator, List, Optional, Sequence
+
+import numpy as np
+
+from ..config import validate_max_sample_size
+
+__all__ = ["AlgorithmLOracle"]
+
+
+class AlgorithmLOracle:
+    """Single-stream Algorithm-L reservoir sampler (duplicates allowed).
+
+    Semantics match the reference engine ``RandomElements``
+    (``Sampler.scala:196-332``); lifecycle (single-use/reusable) is layered on
+    top by :mod:`reservoir_tpu.api`.
+
+    Args:
+      k: reservoir capacity (``maxSampleSize``).
+      rng: explicit RNG (``numpy.random.Generator``).
+      map_fn: ``A => B`` applied on *accept* — it may be called more than ``k``
+        times because accepted elements can later be evicted (doc contract at
+        ``Sampler.scala:116``; invariant 5).
+      pre_allocate: allocate the full ``k``-slot buffer up front instead of
+        growing geometrically from 16 (``Sampler.scala:200-202, 210-222``).
+        Behaviorally invisible; exposed for API parity.
+    """
+
+    def __init__(
+        self,
+        k: int,
+        rng: np.random.Generator,
+        map_fn: Optional[Callable[[Any], Any]] = None,
+        pre_allocate: bool = False,
+    ) -> None:
+        self._k = validate_max_sample_size(int(k))
+        self._rng = rng
+        self._map = map_fn if map_fn is not None else lambda x: x
+        # Growable buffer semantics (Sampler.scala:200-222). A Python list
+        # already grows geometrically; `pre_allocate` is kept for parity and
+        # exercised by allocating up front.
+        self._samples: List[Any] = [None] * self._k if pre_allocate else []
+        self._pre_allocate = pre_allocate
+        self._count: int = 0
+        self._log_w: float = 0.0
+        self._next: int = self._k  # absolute 1-based index of next acceptance
+        self._advance()
+
+    # -- Algorithm L skip computation (Sampler.scala:228-236) ----------------
+
+    def _advance(self) -> None:
+        """Redraw ``W`` and the absolute index of the next acceptance."""
+        u1 = 1.0 - self._rng.random()  # (0, 1]
+        u2 = 1.0 - self._rng.random()
+        self._log_w += math.log(u1) / self._k
+        w = math.exp(self._log_w)
+        # log1p(-w) is exact for tiny w; w==1 gives -inf -> skip 0.
+        denom = math.log1p(-w) if w < 1.0 else -math.inf
+        if denom == -math.inf:
+            skip = 0
+        else:
+            skip = math.floor(math.log(u2) / denom)
+        self._next += skip + 1
+
+    def _evict(self, element: Any) -> None:
+        """Overwrite a uniformly random slot (``Sampler.scala:243-246``)."""
+        slot = int(self._rng.integers(self._k))
+        self._samples[slot] = self._map(element)
+        self._advance()
+
+    def _append(self, element: Any) -> None:
+        if self._pre_allocate:
+            self._samples[self._count - 1] = self._map(element)
+        else:
+            self._samples.append(self._map(element))
+
+    # -- public per-element / bulk API ---------------------------------------
+
+    @property
+    def count(self) -> int:
+        return self._count
+
+    @property
+    def k(self) -> int:
+        return self._k
+
+    def sample(self, element: Any) -> None:
+        """Per-element hot path (``Sampler.scala:248-259``)."""
+        self._count += 1
+        if self._count <= self._k:
+            self._append(element)
+        elif self._count >= self._next:
+            self._evict(element)
+
+    def sample_all(self, elements: Iterable[Any]) -> None:
+        """Bulk path: skipped elements are never touched.
+
+        Mirrors ``sampleAllImpl`` dispatch (``Sampler.scala:289-316``):
+        random-access sequences use index jumping (``sampleIndexed``,
+        ``:261-273``); other iterables use iterator-dropping
+        (``sampleIterator``, ``:275-287``).  Produces results identical to a
+        per-element loop under the same RNG state (invariant 4; tested).
+        """
+        if isinstance(elements, (Sequence, np.ndarray)) and not isinstance(
+            elements, (str, bytes)
+        ):
+            self._sample_indexed(elements)
+        else:
+            self._sample_iterator(iter(elements))
+
+    def _sample_indexed(self, seq: Sequence[Any]) -> None:
+        n = len(seq)
+        i = 0
+        # fill phase
+        while self._count < self._k and i < n:
+            self._count += 1
+            self._append(seq[i])
+            i += 1
+        # skip-jump phase: land directly on acceptance indices.
+        # seq[i] has absolute stream index count+1, so the next acceptance
+        # (absolute index `next`) sits at offset i + (next - count) - 1.
+        while True:
+            target = i + (self._next - self._count) - 1
+            if target >= n:
+                self._count += n - i
+                return
+            self._count += target - i + 1
+            i = target + 1
+            self._evict(seq[target])
+
+    def _sample_iterator(self, it: Iterator[Any]) -> None:
+        while True:
+            skip = self._next - self._count - 1
+            if self._count < self._k:
+                # fill phase consumes elements one by one
+                try:
+                    elem = next(it)
+                except StopIteration:
+                    return
+                self._count += 1
+                self._append(elem)
+                continue
+            # drop `skip` elements without touching them
+            consumed = _drop(it, skip)
+            self._count += consumed
+            if consumed < skip:
+                return
+            try:
+                elem = next(it)
+            except StopIteration:
+                return
+            self._count += 1
+            self._evict(elem)
+
+    def result(self) -> List[Any]:
+        """Current sample; fewer than ``k`` seen -> all of them, in arrival
+        order (truncation, ``Sampler.scala:318-331``).  Returns a fresh list —
+        the reference's zero-copy/copy-on-write machinery
+        (``Sampler.scala:353-381``) is an optimization its tests treat as
+        invisible."""
+        size = min(self._count, self._k)
+        return list(self._samples[:size])
+
+
+def _drop(it: Iterator[Any], n: int) -> int:
+    """Advance ``it`` by up to ``n`` elements; return how many were consumed."""
+    count = 0
+    for _ in itertools.islice(it, n):
+        count += 1
+    return count

@@ -1,0 +1,126 @@
+"""CPU semantic oracle for distinct-value sampling (salted bottom-k hashing).
+
+Re-derivation of the reference's ``RandomValues`` engine
+(``Sampler.scala:383-412``): keep the ``k`` *distinct* values whose salted
+64-bit scrambled hashes are smallest.  Every distinct value then has uniform
+inclusion probability k/D (D = number of distinct values), because the
+scramble induces an independent uniform random order on values
+(``Sampler.scala:16-17`` doc contract; bias only from 64-bit collisions).
+
+Structure mirrors the reference hot path (``Sampler.scala:394-408``):
+
+- a max-heap of (hash, value) keyed on hash — the current bottom-k, with the
+  *largest* retained hash on top;
+- a membership set of values for O(1) dedup;
+- a cached ``max_hash`` threshold so the common case (hash above threshold) is
+  one compare + one set lookup.
+
+Unlike duplicates mode, ``map`` is applied to *every* element (it feeds the
+hash; ``Sampler.scala:155, 395``).  The hash/scramble is the shared
+integer-only spec in :mod:`reservoir_tpu.ops.hashing`, so this oracle is
+bit-compatible with the device kernel.
+"""
+
+from __future__ import annotations
+
+import heapq
+from typing import Any, Callable, Iterable, List, Optional, Set, Tuple
+
+import numpy as np
+
+from ..config import validate_max_sample_size
+from ..ops.hashing import draw_salts, scramble64_int
+
+__all__ = ["BottomKOracle"]
+
+_U64 = (1 << 64) - 1
+
+
+def _default_hash(value: Any) -> int:
+    """Default user hash as a stable 64-bit pattern.
+
+    Mirrors ``defaultHashFunction = _.hashCode().toLong`` (``Sampler.scala:75``):
+    identity for ints (as in Scala for Int/Long — what the device kernel uses),
+    FNV-1a over the bytes for str/bytes.  Deliberately *not* Python's builtin
+    ``hash()``, which is salted per process and would break reproducibility.
+    Other types must supply an explicit ``hash_fn``.
+    """
+    if isinstance(value, (int, np.integer)):
+        return int(value) & _U64
+    if isinstance(value, str):
+        value = value.encode("utf-8")
+    if isinstance(value, (bytes, bytearray)):
+        h = 0xCBF29CE484222325  # FNV-1a 64-bit
+        for b in value:
+            h = ((h ^ b) * 0x100000001B3) & _U64
+        return h
+    raise TypeError(
+        f"no stable default hash for {type(value).__name__}; pass hash_fn="
+    )
+
+
+class BottomKOracle:
+    """Single-stream distinct-value sampler (bottom-k min-hashing)."""
+
+    def __init__(
+        self,
+        k: int,
+        rng: np.random.Generator,
+        map_fn: Optional[Callable[[Any], Any]] = None,
+        hash_fn: Optional[Callable[[Any], int]] = None,
+        salts: Optional[Tuple[int, int]] = None,
+    ) -> None:
+        self._k = validate_max_sample_size(int(k))
+        self._map = map_fn if map_fn is not None else lambda x: x
+        self._hash = hash_fn if hash_fn is not None else _default_hash
+        # Per-instance salts drawn once (Sampler.scala:385-388); injectable
+        # for determinism tests (no reflection needed).
+        self._salts = salts if salts is not None else draw_salts(rng)
+        # Max-heap via negated hash (heapq is a min-heap).
+        self._heap: List[Tuple[int, int, Any]] = []  # (-hash, tiebreak, value)
+        self._members: Set[Any] = set()
+        self._max_hash: int = -1  # threshold; -1 while not full
+        self._tie = 0  # monotonic tiebreaker so values never get compared
+        self._count = 0
+
+    @property
+    def count(self) -> int:
+        return self._count
+
+    def _scrambled(self, element: Any) -> Tuple[Any, int]:
+        value = self._map(element)  # applied to EVERY element (Sampler.scala:395)
+        return value, scramble64_int(self._hash(value), self._salts)
+
+    def sample(self, element: Any) -> None:
+        """Per-element hot path (``Sampler.scala:394-408``)."""
+        self._count += 1
+        value, h = self._scrambled(element)
+        if len(self._heap) < self._k:
+            if value not in self._members:
+                self._tie += 1
+                heapq.heappush(self._heap, (-h, self._tie, value))
+                self._members.add(value)
+                self._max_hash = max(self._max_hash, h)
+        elif h < self._max_hash and value not in self._members:
+            _, _, evicted = heapq.heapreplace(
+                self._heap, (-h, self._tie + 1, value)
+            )
+            self._tie += 1
+            self._members.discard(evicted)
+            self._members.add(value)
+            self._max_hash = -self._heap[0][0]
+
+    def sample_all(self, elements: Iterable[Any]) -> None:
+        for element in elements:
+            self.sample(element)
+
+    def result(self) -> List[Any]:
+        """The sampled distinct values.  Order is not specified by the
+        contract (``Sampler.scala:411``); we return them sorted by scrambled
+        hash so the output is deterministic and directly comparable with the
+        device kernel's sorted bottom-k."""
+        return [v for (_nh, _t, v) in sorted(self._heap, key=lambda e: -e[0])]
+
+    def threshold(self) -> int:
+        """Current max retained hash (testing hook)."""
+        return self._max_hash

@@ -1,0 +1,24 @@
+"""Test configuration.
+
+Tests run on a virtual 8-device CPU mesh so that multi-chip sharding layouts
+are exercised without TPU hardware (SURVEY §4.4).  The axon sitecustomize hook
+registers the TPU backend at interpreter startup, so we switch platforms
+post-import but before any backend is initialized.
+
+Set ``RESERVOIR_TPU_TEST_PLATFORM=native`` to run the suite on whatever
+platform JAX picks (e.g. the real TPU chip).
+"""
+
+from __future__ import annotations
+
+import os
+
+if os.environ.get("RESERVOIR_TPU_TEST_PLATFORM", "cpu8") == "cpu8":
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+    )
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+else:  # pragma: no cover - hardware run
+    import jax  # noqa: F401

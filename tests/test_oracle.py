@@ -1,0 +1,240 @@
+"""M0 oracle tests — the reference's core test strategy re-derived.
+
+Mirrors ``SamplerTest.scala`` groups: degenerate exactness (:81-91),
+probabilistic boundary (:93-115), ``sample == sampleAll`` determinism
+(:117-142), uniformity within 5 sigma (:144-176), pairwise independence
+(:178-240), and distinct-vs-duplicates semantics (:319-339) — with explicit
+RNG injection instead of the reference's reflection hack (:16-54).
+"""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+import pytest
+
+from reservoir_tpu.oracle import AlgorithmLOracle, BottomKOracle
+from reservoir_tpu.ops.hashing import scramble64_int
+
+
+def make_rng(seed: int = 0) -> np.random.Generator:
+    return np.random.default_rng(seed)
+
+
+# ---------------------------------------------------------------- degenerate
+
+
+@pytest.mark.parametrize("pre_allocate", [False, True])
+class TestDegenerate:
+    def test_n_equals_k(self, pre_allocate):
+        s = AlgorithmLOracle(10, make_rng(), pre_allocate=pre_allocate)
+        s.sample_all(range(10))
+        assert s.result() == list(range(10))
+
+    def test_n_less_than_k(self, pre_allocate):
+        s = AlgorithmLOracle(10, make_rng(), pre_allocate=pre_allocate)
+        s.sample_all(range(7))
+        assert s.result() == list(range(7))  # arrival order (invariant 3)
+
+    def test_empty(self, pre_allocate):
+        s = AlgorithmLOracle(10, make_rng(), pre_allocate=pre_allocate)
+        assert s.result() == []
+
+    def test_fill_order(self, pre_allocate):
+        s = AlgorithmLOracle(5, make_rng(), pre_allocate=pre_allocate)
+        for x in "abcde":
+            s.sample(x)
+        assert s.result() == list("abcde")  # invariant 1
+
+
+# ------------------------------------------------------- probabilistic bounds
+
+
+def test_element_after_k_sometimes_sampled():
+    # P(element k+1 not in sample) = 1 - k/(k+1); over 200 seeds the chance
+    # that it is NEVER sampled is (1/6)^200 — the test failing spuriously is
+    # impossible for practical purposes (cf. SamplerTest.scala:93-103).
+    k = 5
+    hits = 0
+    for seed in range(200):
+        s = AlgorithmLOracle(k, make_rng(seed))
+        s.sample_all(range(k + 1))
+        if k in s.result():
+            hits += 1
+    assert 0 < hits < 200
+
+
+def test_not_always_sampled_deep_stream():
+    # With n = 10k the last element has inclusion probability k/n = 1/10;
+    # over 100 seeds, P(always sampled) = (1/10)^100.
+    k, n = 10, 100
+    always = True
+    for seed in range(100):
+        s = AlgorithmLOracle(k, make_rng(seed))
+        s.sample_all(range(n))
+        if (n - 1) not in s.result():
+            always = False
+            break
+    assert not always
+
+
+# ------------------------------------------- sample == sampleAll determinism
+
+
+def chunked_feeds(n):
+    """Mixed chunk shapes hitting the indexed, iterator and ndarray paths
+    (cf. SamplerTest.scala:125-127)."""
+    elements = list(range(n))
+    feeds = []
+    i = 0
+    toggle = 0
+    while i < n:
+        size = [17, 256, 3, 101, 64][toggle % 5]
+        chunk = elements[i : i + size]
+        if toggle % 3 == 0:
+            feeds.append(chunk)  # list -> indexed path
+        elif toggle % 3 == 1:
+            feeds.append(iter(chunk))  # generator -> iterator path
+        else:
+            feeds.append(np.array(chunk))  # ndarray -> indexed path
+        i += size
+        toggle += 1
+    return feeds
+
+
+@pytest.mark.parametrize("n", [5, 64, 3000])
+@pytest.mark.parametrize("k", [1, 8, 128])
+def test_sample_equals_sample_all(n, k):
+    # Invariant 4 (SURVEY §2.2): bulk paths are pure optimizations.
+    a = AlgorithmLOracle(k, make_rng(42))
+    for x in range(n):
+        a.sample(x)
+    b = AlgorithmLOracle(k, make_rng(42))
+    for feed in chunked_feeds(n):
+        b.sample_all(feed)
+    assert a.result() == b.result()
+    assert a.count == b.count == n
+
+
+def test_sample_all_single_iterator():
+    a = AlgorithmLOracle(16, make_rng(7))
+    a.sample_all(iter(range(2000)))
+    b = AlgorithmLOracle(16, make_rng(7))
+    for x in range(2000):
+        b.sample(x)
+    assert a.result() == b.result()
+
+
+def test_map_applied_on_accept():
+    # Invariant 5: map applied on accept, possibly more than k times.
+    calls = []
+
+    def mapper(x):
+        calls.append(x)
+        return x * 2
+
+    s = AlgorithmLOracle(4, make_rng(3), map_fn=mapper)
+    s.sample_all(range(100))
+    assert all(v % 2 == 0 for v in s.result())
+    assert len(calls) >= 4  # at least the fill phase
+    assert len(calls) < 100  # skipped elements never touched
+
+
+# ---------------------------------------------------------------- uniformity
+
+
+def test_uniformity_5_sigma():
+    # Sample k=5 of n=10, T trials; each element's selection count must lie
+    # within 5 sigma of T/2 (cf. SamplerTest.scala:144-176).
+    n, k, trials = 10, 5, 20_000
+    counts = np.zeros(n, dtype=np.int64)
+    for seed in range(trials):
+        s = AlgorithmLOracle(k, make_rng(seed + 1000))
+        s.sample_all(range(n))
+        counts[s.result()] += 1
+    expected = trials * k / n
+    sigma = math.sqrt(trials * 0.5 * 0.5)
+    assert np.all(np.abs(counts - expected) < 5 * sigma), counts
+
+
+def test_pairwise_independence_5_sigma():
+    # Counts of "pair has same fate" within 5 sigma of T * 4/9 for n=10, k=5
+    # (P(both in) + P(both out) = 2/9 + 2/9; cf. SamplerTest.scala:178-240).
+    n, k, trials = 10, 5, 20_000
+    same = np.zeros((n, n), dtype=np.int64)
+    for seed in range(trials):
+        s = AlgorithmLOracle(k, make_rng(seed + 5000))
+        members = np.zeros(n, dtype=bool)
+        s.sample_all(range(n))
+        members[s.result()] = True
+        agree = members[:, None] == members[None, :]
+        same += agree
+    p = 4.0 / 9.0
+    sigma = math.sqrt(trials * p * (1 - p))
+    off_diag = ~np.eye(n, dtype=bool)
+    assert np.all(np.abs(same[off_diag] - trials * p) < 5 * sigma)
+
+
+# ------------------------------------------------------------------ distinct
+
+
+def test_distinct_dedups():
+    # 10x the same value yields exactly one (SamplerTest.scala:319-339).
+    s = BottomKOracle(5, make_rng(1))
+    s.sample_all([7] * 10)
+    assert s.result() == [7]
+
+
+def test_duplicates_mode_keeps_duplicates():
+    s = AlgorithmLOracle(10, make_rng(1))
+    s.sample_all([7] * 10)
+    assert s.result() == [7] * 10
+
+
+def test_distinct_is_bottom_k_of_scrambled_hash():
+    # The result must be exactly the k distinct values with smallest
+    # scrambled hashes (Sampler.scala:396-408), independent of arrival order
+    # or duplication.
+    k = 8
+    salts = (0x0123456789ABCDEF, 0xFEDCBA9876543210)
+    values = list(range(100))
+    stream = values * 3 + values[::-1]
+    s = BottomKOracle(k, make_rng(2), salts=salts)
+    s.sample_all(stream)
+    expected = sorted(values, key=lambda v: scramble64_int(v, salts))[:k]
+    assert sorted(s.result()) == sorted(expected)
+
+
+def test_distinct_fewer_than_k():
+    s = BottomKOracle(50, make_rng(3))
+    s.sample_all([1, 2, 3, 2, 1])
+    assert sorted(s.result()) == [1, 2, 3]
+
+
+def test_distinct_uniform_over_values():
+    # Every distinct value equally likely regardless of duplication skew.
+    n, k, trials = 10, 5, 4_000
+    counts = np.zeros(n, dtype=np.int64)
+    for seed in range(trials):
+        rng = make_rng(seed + 9000)
+        s = BottomKOracle(k, rng)
+        # heavily skewed duplication: value v appears v+1 times
+        stream = [v for v in range(n) for _ in range(v + 1)]
+        s.sample_all(stream)
+        counts[s.result()] += 1
+    expected = trials * k / n
+    sigma = math.sqrt(trials * 0.5 * 0.5)
+    assert np.all(np.abs(counts - expected) < 5 * sigma), counts
+
+
+def test_distinct_map_applied_every_element():
+    calls = []
+
+    def mapper(x):
+        calls.append(x)
+        return x
+
+    s = BottomKOracle(4, make_rng(5), map_fn=mapper)
+    s.sample_all(range(50))
+    assert len(calls) == 50  # map feeds the hash (Sampler.scala:395)
