@@ -1,0 +1,82 @@
+"""Headline benchmark: vmapped Algorithm-L throughput on one chip.
+
+Measures sustained elements/sec across R concurrent k-reservoirs in steady
+state (BASELINE.md north star: >= 1e9 elem/s across 65,536 k=128 reservoirs
+on one TPU v5e chip).  The stream is device-resident synthetic int32 data —
+the TPU analog of the reference's in-memory 1M-element iterator
+(BASELINE.md config 1); host-feed throughput is benchmarked separately by
+the stream bridge.
+
+Prints exactly ONE JSON line:
+  {"metric": ..., "value": N, "unit": "elem/s", "vs_baseline": N}
+
+Env knobs:
+  RESERVOIR_BENCH_SMOKE=1   tiny shapes for a CPU smoke run
+  RESERVOIR_BENCH_R/K/B/STEPS  override the config
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import jax.random as jr
+
+from reservoir_tpu.ops import algorithm_l as al
+
+NORTH_STAR = 1e9  # elem/s (BASELINE.md)
+
+
+def main() -> None:
+    smoke = os.environ.get("RESERVOIR_BENCH_SMOKE") == "1"
+    R = int(os.environ.get("RESERVOIR_BENCH_R", 1024 if smoke else 65536))
+    k = int(os.environ.get("RESERVOIR_BENCH_K", 128))
+    B = int(os.environ.get("RESERVOIR_BENCH_B", 256 if smoke else 2048))
+    steps = int(os.environ.get("RESERVOIR_BENCH_STEPS", 5 if smoke else 50))
+
+    state = al.init(jr.key(0), R, k)
+
+    @jax.jit
+    def fill_step(state, step):
+        base = (step * (R * B)).astype(jnp.int32)
+        batch = base + jax.lax.broadcasted_iota(jnp.int32, (R, B), 1)
+        return al.update(state, batch)
+
+    @jax.jit
+    def steady_step(state, step):
+        base = (step * (R * B)).astype(jnp.int32)
+        batch = base + jax.lax.broadcasted_iota(jnp.int32, (R, B), 1)
+        return al.update_steady(state, batch)
+
+    # fill phase + warm-up compile of both paths
+    state = fill_step(state, jnp.asarray(0, jnp.int32))
+    while int(state.count[0]) < k:
+        state = fill_step(state, jnp.asarray(1, jnp.int32))
+    state = steady_step(state, jnp.asarray(2, jnp.int32))
+    jax.block_until_ready(state)
+
+    t0 = time.perf_counter()
+    for s in range(steps):
+        state = steady_step(state, jnp.asarray(3 + s, jnp.int32))
+    jax.block_until_ready(state)
+    dt = time.perf_counter() - t0
+
+    value = R * B * steps / dt
+    print(
+        json.dumps(
+            {
+                "metric": f"algl_steady_elements_per_sec_R{R}_k{k}_B{B}",
+                "value": value,
+                "unit": "elem/s",
+                "vs_baseline": value / NORTH_STAR,
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    sys.exit(main())

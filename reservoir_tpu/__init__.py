@@ -28,6 +28,21 @@ from .errors import AbruptStreamTermination, SamplerClosedError, StreamCancelled
 
 __version__ = "0.1.0"
 
+
+def __getattr__(name):
+    # Lazy: importing reservoir_tpu must not pull in jax (the oracle/API layer
+    # is numpy-only; keeps CPU-only consumers and import time light).
+    if name in ("sampler", "distinct", "Sampler"):
+        from . import api
+
+        return getattr(api, name)
+    if name == "ReservoirEngine":
+        from .engine import ReservoirEngine
+
+        return ReservoirEngine
+    raise AttributeError(f"module 'reservoir_tpu' has no attribute {name!r}")
+
+
 __all__ = [
     "MAX_SIZE",
     "DEFAULT_INITIAL_SIZE",
@@ -35,5 +50,9 @@ __all__ = [
     "SamplerClosedError",
     "AbruptStreamTermination",
     "StreamCancelled",
+    "Sampler",
+    "sampler",
+    "distinct",
+    "ReservoirEngine",
     "__version__",
 ]

@@ -1,0 +1,201 @@
+"""Host Sampler API — the reference's public trait and factories.
+
+Mirrors ``trait Sampler[A,B]`` (``Sampler.scala:26-68``): ``sample``,
+``sample_all`` (default per-element loop, ``:50``), ``result``, ``is_open`` —
+plus the factory/validation surface of ``object Sampler``
+(``Sampler.scala:70-180``) and its lifecycle matrix:
+
+====================  =========================================  ==========================
+factory               single-use (default)                       reusable
+====================  =========================================  ==========================
+:func:`sampler`       ``SingleUseRandomElements`` (:334-351)     ``MultiResultRandomElements`` (:353-381)
+:func:`distinct`      ``SingleUseRandomValues`` (:414-428)       ``MultiResultRandomValues`` (:430-433)
+====================  =========================================  ==========================
+
+Single-use semantics: ``result()`` closes the sampler and frees its buffers
+(GC-nulling, ``:345-350``); any later ``sample``/``sample_all``/``result``
+raises :class:`~reservoir_tpu.errors.SamplerClosedError`
+(``SingleUse.checkOpen``, ``:185-186``); ``is_open`` stays callable (``:193``).
+Reusable semantics: ``result()`` returns an independent snapshot and sampling
+may continue; earlier snapshots are never clobbered (the reference guarantees
+this with copy-on-write aliasing, ``:357-379`` — here snapshots are plain
+copies, observably identical).
+
+These host samplers run the CPU oracles — they are the semantic baseline
+(BASELINE.md config 1).  The batch/device counterpart with the same lifecycle
+is :class:`reservoir_tpu.engine.ReservoirEngine`.
+
+Samplers are NOT thread-safe, matching the reference's documented contract
+(``Sampler.scala:19, 105, 143``).
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Any, Callable, Iterable, List, Optional, Tuple, Union
+
+import numpy as np
+
+from .config import validate_non_distinct_params
+from .errors import SamplerClosedError
+from .oracle.algorithm_l import AlgorithmLOracle
+from .oracle.bottom_k import BottomKOracle
+
+__all__ = ["Sampler", "sampler", "distinct"]
+
+_identity = lambda x: x  # noqa: E731
+
+
+class Sampler(abc.ABC):
+    """Public sampler trait (``Sampler.scala:26-68``).
+
+    Not reusable unless stated otherwise; not thread-safe (doc contract,
+    ``Sampler.scala:14-19``).
+    """
+
+    @abc.abstractmethod
+    def sample(self, element: Any) -> None:
+        """Sample a single element (``Sampler.scala:38``)."""
+
+    def sample_all(self, elements: Iterable[Any]) -> None:
+        """Sample every element; default per-element loop (``Sampler.scala:50``).
+        Implementations override with skip-jump bulk paths that must produce
+        identical results under identical RNG state (invariant 4)."""
+        for element in elements:
+            self.sample(element)
+
+    @abc.abstractmethod
+    def result(self) -> List[Any]:
+        """The sampled elements (``Sampler.scala:60``).  Single-use samplers
+        close; reusable samplers snapshot."""
+
+    @property
+    @abc.abstractmethod
+    def is_open(self) -> bool:
+        """Whether this sampler can still sample (``Sampler.scala:67``)."""
+
+
+class _SingleUseMixin:
+    """Lifecycle state machine (``SingleUse``, ``Sampler.scala:182-194``)."""
+
+    _open = True
+
+    def _check_open(self) -> None:
+        if not self._open:
+            raise SamplerClosedError(
+                "this sampler is single-use, and no longer open"
+            )
+
+    def _close(self) -> None:
+        self._open = False
+
+    @property
+    def is_open(self) -> bool:
+        return self._open
+
+
+class _SingleUseSampler(_SingleUseMixin, Sampler):
+    """Single-use wrapper over an oracle engine (``Sampler.scala:334-351,
+    414-428``)."""
+
+    def __init__(self, engine) -> None:
+        self._engine = engine
+
+    def sample(self, element: Any) -> None:
+        self._check_open()
+        self._engine.sample(element)
+
+    def sample_all(self, elements: Iterable[Any]) -> None:
+        self._check_open()
+        self._engine.sample_all(elements)
+
+    def result(self) -> List[Any]:
+        self._check_open()
+        res = self._engine.result()
+        self._close()
+        self._engine = None  # free for GC (Sampler.scala:345-350)
+        return res
+
+
+class _ReusableSampler(Sampler):
+    """Reusable wrapper (``Sampler.scala:353-381, 430-433``): ``result()``
+    snapshots without closing; ``is_open`` is always True (``:380``)."""
+
+    def __init__(self, engine) -> None:
+        self._engine = engine
+
+    def sample(self, element: Any) -> None:
+        self._engine.sample(element)
+
+    def sample_all(self, elements: Iterable[Any]) -> None:
+        self._engine.sample_all(elements)
+
+    def result(self) -> List[Any]:
+        return self._engine.result()  # oracles return fresh lists: snapshot
+
+    @property
+    def is_open(self) -> bool:
+        return True
+
+
+def _resolve_rng(rng: Union[None, int, np.random.Generator]) -> np.random.Generator:
+    """Explicit RNG in, reproducibility out — the constructor-input design the
+    reference's reflection-based tests argue for (``SamplerTest.scala:16-54``)."""
+    if isinstance(rng, np.random.Generator):
+        return rng
+    return np.random.default_rng(rng)
+
+
+def sampler(
+    max_sample_size: int,
+    *,
+    pre_allocate: bool = False,
+    reusable: bool = False,
+    map_fn: Optional[Callable[[Any], Any]] = None,
+    rng: Union[None, int, np.random.Generator] = None,
+) -> Sampler:
+    """Uniform reservoir sampler, duplicates allowed (``Sampler.apply``,
+    ``Sampler.scala:130-136``).
+
+    Each element of the stream has ``k/n`` inclusion probability.  ``map_fn``
+    is applied on accept and may be called more than ``k`` times
+    (``Sampler.scala:116``).  ``rng`` may be a seed or a ``numpy`` Generator.
+    """
+    map_fn = map_fn if map_fn is not None else _identity
+    validate_non_distinct_params(max_sample_size, map_fn)
+    engine = AlgorithmLOracle(
+        max_sample_size, _resolve_rng(rng), map_fn=map_fn, pre_allocate=pre_allocate
+    )
+    return _ReusableSampler(engine) if reusable else _SingleUseSampler(engine)
+
+
+def distinct(
+    max_sample_size: int,
+    *,
+    reusable: bool = False,
+    map_fn: Optional[Callable[[Any], Any]] = None,
+    hash_fn: Optional[Callable[[Any], int]] = None,
+    rng: Union[None, int, np.random.Generator] = None,
+    salts: Optional[Tuple[int, int]] = None,
+) -> Sampler:
+    """Distinct-value sampler (``Sampler.distinct``, ``Sampler.scala:173-180``).
+
+    Each *distinct value* of the stream has uniform inclusion probability.
+    ``map_fn`` is applied to every element (it feeds the hash,
+    ``Sampler.scala:155``); ``hash_fn`` defaults to a stable 64-bit identity/
+    FNV hash (``Sampler.scala:75`` analog).
+    """
+    map_fn = map_fn if map_fn is not None else _identity
+    validate_non_distinct_params(max_sample_size, map_fn)
+    if hash_fn is not None:
+        from .config import validate_hash
+
+        validate_hash(hash_fn)  # explicit hash must be callable (:92-95)
+    engine = BottomKOracle(
+        max_sample_size,
+        _resolve_rng(rng),
+        map_fn=map_fn,
+        hash_fn=hash_fn,  # None -> oracle's stable default (Sampler.scala:75)
+        salts=salts,
+    )
+    return _ReusableSampler(engine) if reusable else _SingleUseSampler(engine)

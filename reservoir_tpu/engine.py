@@ -1,0 +1,206 @@
+"""ReservoirEngine — R lockstep device reservoirs behind the Sampler lifecycle.
+
+This is the batch/device counterpart of :mod:`reservoir_tpu.api`: the same
+construction-time validation, single-use/reusable lifecycle and result
+truncation contract as the reference factories, but the "element" granularity
+is a ``[R, B]`` tile — reservoir ``r`` consumes ``tile[r, :valid[r]]`` of its
+own stream.  The engine owns:
+
+- the pure :class:`~reservoir_tpu.ops.algorithm_l.ReservoirState` pytree
+  (device-resident, never mutated in place — every sampler is copy-on-write
+  for free, making ``reusable`` trivial; cf. the reference's aliasing
+  machinery ``Sampler.scala:353-381``);
+- jitted update functions cached per (tile width, steady, map_fn) —
+  jit-compile is the engine's analog of the reference release-build inliner
+  (``build.sbt:134-141``);
+- the fill/steady dispatch: reservoirs advance in lockstep, so a host-side
+  lower bound on ``count`` (no device sync) decides when the fill-phase
+  scatter can be dropped from the compiled program.
+
+Distinct and weighted configs are rejected here for now; their device engines
+arrive with SURVEY §7.2 M3/M6 and will share this lifecycle surface.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, List, Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import jax.random as jr
+import numpy as np
+
+from .config import SamplerConfig, validate_max_sample_size
+from .errors import SamplerClosedError
+from .ops import algorithm_l as _algl
+
+__all__ = ["ReservoirEngine"]
+
+
+class ReservoirEngine:
+    """R independent k-reservoirs updated in lockstep on device.
+
+    Args:
+      config: engine configuration (k, R, dtypes, tile size).
+      key: JAX PRNG key (or ``seed`` int).  Explicit-by-construction
+        reproducibility (``SamplerTest.scala:16-54``'s lesson).
+      map_fn: traceable map applied on accept (``Sampler.scala:116``).
+      reusable: reference lifecycle switch (``Sampler.scala:130-136``);
+        single-use engines free device buffers on ``result()``.
+    """
+
+    def __init__(
+        self,
+        config: SamplerConfig,
+        key: Union[int, jax.Array, None] = None,
+        map_fn: Optional[Callable] = None,
+        reusable: bool = False,
+    ) -> None:
+        validate_max_sample_size(config.max_sample_size)
+        if config.distinct or config.weighted:
+            raise NotImplementedError(
+                "use DistinctEngine / WeightedEngine for those modes"
+            )
+        self._config = config
+        self._map_fn = map_fn
+        self._reusable = reusable
+        self._open = True
+        if key is None or isinstance(key, int):
+            key = jr.key(0 if key is None else key)
+        self._state = _algl.init(
+            key,
+            config.num_reservoirs,
+            config.max_sample_size,
+            sample_dtype=jnp.dtype(config.resolved_sample_dtype()),
+            count_dtype=jnp.dtype(config.count_dtype),
+        )
+        # Host-side lower bound on every reservoir's count — exact when all
+        # tiles are full-width, conservative under ragged `valid`.  Decides
+        # fill vs steady dispatch with no device readback.
+        self._min_count = 0
+        self._jit_cache: dict = {}
+
+    # ------------------------------------------------------------ properties
+
+    @property
+    def config(self) -> SamplerConfig:
+        return self._config
+
+    @property
+    def is_open(self) -> bool:
+        """Reference ``isOpen`` (``Sampler.scala:67``): reusable engines are
+        always open (``:380``); single-use close on ``result()``."""
+        return True if self._reusable else self._open
+
+    @property
+    def state(self) -> _algl.ReservoirState:
+        """A snapshot of the state pytree.  Copied, because the engine's
+        jitted updates donate the previous state's buffers (the streaming
+        fast path) — handing out the live buffers would let a later
+        ``sample()`` delete them out from under the caller."""
+        self._check_open()
+        return jax.tree.map(lambda x: x.copy(), self._state)
+
+    # ------------------------------------------------------------- lifecycle
+
+    def _check_open(self) -> None:
+        if not self._reusable and not self._open:
+            raise SamplerClosedError(
+                "this engine is single-use, and no longer open"
+            )
+
+    # -------------------------------------------------------------- sampling
+
+    def _update_fn(self, width: int, steady: bool):
+        cache_key = (width, steady)
+        fn = self._jit_cache.get(cache_key)
+        if fn is None:
+            base = _algl.update_steady if steady else _algl.update
+            fn = jax.jit(
+                functools.partial(base, map_fn=self._map_fn),
+                donate_argnums=(0,),
+            )
+            self._jit_cache[cache_key] = fn
+        return fn
+
+    def sample(self, tile: Any, valid: Optional[Any] = None) -> None:
+        """Consume one ``[R, B]`` tile (the engine's per-element hot path —
+        the batched analog of ``Sampler.scala:248-259``)."""
+        self._check_open()
+        tile = jnp.asarray(tile)
+        if tile.ndim != 2 or tile.shape[0] != self._config.num_reservoirs:
+            raise ValueError(
+                f"tile must be [num_reservoirs={self._config.num_reservoirs}, B], "
+                f"got {tile.shape}"
+            )
+        width = tile.shape[1]
+        steady = self._min_count >= self._config.max_sample_size
+        fn = self._update_fn(width, steady)
+        if valid is None:
+            self._state = fn(self._state, tile)
+            self._min_count += width
+        else:
+            valid_np = np.asarray(valid, np.int32)
+            if valid_np.shape != (self._config.num_reservoirs,):
+                raise ValueError(
+                    f"valid must be [{self._config.num_reservoirs}], got {valid_np.shape}"
+                )
+            if np.any(valid_np < 0) or np.any(valid_np > width):
+                raise ValueError(
+                    f"valid entries must be in [0, {width}], got "
+                    f"[{valid_np.min()}, {valid_np.max()}]"
+                )
+            self._state = fn(self._state, tile, jnp.asarray(valid_np))
+            self._min_count += int(valid_np.min())
+
+    def sample_all(self, tiles: Any) -> None:
+        """Consume an iterable of tiles (bulk path, ``Sampler.scala:341``)."""
+        self._check_open()
+        for tile in tiles:
+            if isinstance(tile, tuple):
+                self.sample(tile[0], tile[1])
+            else:
+                self.sample(tile)
+
+    def sample_stream(self, stream: Any, tile_width: Optional[int] = None) -> None:
+        """Feed one ``[R, N]`` array, auto-tiled to ``config.tile_size``
+        columns with a masked ragged tail — never re-jitting per remainder."""
+        self._check_open()
+        stream = np.asarray(stream)
+        R, N = stream.shape
+        B = tile_width or self._config.tile_size
+        for start in range(0, N, B):
+            chunk = stream[:, start : start + B]
+            w = chunk.shape[1]
+            if w < B:
+                pad = np.zeros((R, B - w), chunk.dtype)
+                self.sample(
+                    np.concatenate([chunk, pad], axis=1),
+                    np.full((R,), w, np.int32),
+                )
+            else:
+                self.sample(chunk)
+
+    # --------------------------------------------------------------- results
+
+    def result_arrays(self) -> Tuple[np.ndarray, np.ndarray]:
+        """Device->host result: ``(samples [R, k], sizes [R])`` with the
+        truncation contract of ``Sampler.scala:318-331``.  Single-use engines
+        close and free device buffers (``:345-350``); reusable engines
+        snapshot — earlier results are never clobbered because state arrays
+        are immutable (the copy-on-write guarantee of ``Sampler.scala:353-381``
+        holds structurally)."""
+        self._check_open()
+        samples, sizes = _algl.result(self._state)
+        out = (np.asarray(samples), np.asarray(sizes))
+        if not self._reusable:
+            self._open = False
+            self._state = None  # free device buffers
+            self._jit_cache.clear()
+        return out
+
+    def result(self) -> List[np.ndarray]:
+        """Per-reservoir samples, truncated to their fill level."""
+        samples, sizes = self.result_arrays()
+        return [samples[r, : sizes[r]] for r in range(samples.shape[0])]

@@ -1,0 +1,266 @@
+"""Vmapped Algorithm-L reservoir sampling on device (SURVEY §7.2 M1).
+
+The reference's single mutable sampler (``RandomElements``,
+``Sampler.scala:196-332``) becomes a pure pytree of ``[R, ...]`` arrays — R
+independent reservoirs updated in lockstep by functional transforms:
+
+- per-element hot loop (``Sampler.scala:248-259``)  ->  tile-batched
+  :func:`update`: each reservoir consumes a ``[B]`` slice of its stream per
+  device step;
+- skip-jump bulk path (``Sampler.scala:261-287``)   ->  the acceptance
+  ``while_loop`` jumps straight to accepted positions; a tile containing no
+  acceptance costs one compare per reservoir, and *skipped elements are never
+  gathered* — the Algorithm-L structural win, vectorized;
+- mutable ``rand``/``W``/``nextSampleCount`` fields (``:199-205``)  ->
+  counter-based draws keyed on the absolute accept index
+  (:mod:`reservoir_tpu.ops.rng`), log-space ``W`` (SURVEY §7.3).
+
+Tile-split invariance (the ``sample == sampleAll`` contract,
+``SamplerTest.scala:117-142``): because draws are keyed by absolute index,
+``update`` over any partition of the stream — element-at-a-time, fixed tiles,
+ragged ``valid`` lengths — yields bit-identical state.  Tested in
+``tests/test_device_algl.py``.
+
+Semantics invariants preserved (SURVEY §2.2): fill phase stores the first k
+in arrival order; eviction overwrites a uniform random slot; ``result`` with
+count < k truncates to arrival order; ``map`` is applied on accept only.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import jax.random as jr
+import numpy as np
+
+from .rng import accept_draws
+
+__all__ = ["ReservoirState", "init", "update", "update_steady", "result"]
+
+
+class ReservoirState(NamedTuple):
+    """Pure state of R lockstep reservoirs (the device analog of
+    ``RandomElements``' mutable fields, ``Sampler.scala:199-205``).
+
+    Attributes:
+      samples: ``[R, k]``   stored samples (post-``map``).
+      count:   ``[R]`` int  elements consumed per reservoir.
+      nxt:     ``[R]`` int  absolute 1-based index of the next acceptance;
+               saturates at dtype max (sampling effectively stops there —
+               use int64/x64 for streams longer than 2^31 per reservoir).
+      log_w:   ``[R]`` f32  log of Algorithm L's W.
+      key:     ``[R]``      per-reservoir PRNG keys (split once at init).
+    """
+
+    samples: jax.Array
+    count: jax.Array
+    nxt: jax.Array
+    log_w: jax.Array
+    key: jax.Array
+
+    @property
+    def num_reservoirs(self) -> int:
+        return self.samples.shape[0]
+
+    @property
+    def k(self) -> int:
+        return self.samples.shape[1]
+
+
+def _advance(log_w: jax.Array, nxt: jax.Array, key: jax.Array, idx, k: int):
+    """Algorithm-L skip recomputation (``Sampler.scala:228-236``) using the
+    draws assigned to accept-index ``idx``.
+
+    ``W *= u1^(1/k)`` in log-space; ``next += floor(log(u2)/log(1-W)) + 1``
+    with saturating integer arithmetic (no wraparound past dtype max).
+    """
+    dtype = nxt.dtype
+    maxval = np.iinfo(dtype).max
+    slot, u1, u2 = accept_draws(key, idx, k)
+    log_w = log_w + jnp.log(u1) / k
+    w = jnp.exp(log_w)
+    # w rounding to exactly 1.0 gives log1p(-1) = -inf -> skip 0; fine.
+    skip_f = jnp.floor(jnp.log(u2) / jnp.log1p(-w))
+    # clamp before int cast: huge float -> dtype max would be UB-ish
+    skip = jnp.minimum(skip_f, float(maxval // 2)).astype(dtype)
+    headroom = maxval - skip - 1
+    nxt = jnp.where(nxt > headroom, dtype.type(maxval), nxt + skip + 1)
+    return slot, log_w, nxt
+
+
+def init(
+    key: jax.Array,
+    num_reservoirs: int,
+    k: int,
+    sample_dtype: Any = jnp.int32,
+    count_dtype: Any = jnp.int32,
+) -> ReservoirState:
+    """Create R empty reservoirs (ctor path, ``Sampler.scala:196-207``).
+
+    Device buffers are statically shaped at ``[R, k]`` — the ``preAllocate``
+    mode of the reference is the only mode XLA admits.
+    """
+    count_dtype = jnp.dtype(count_dtype)
+    keys = jr.split(key, num_reservoirs)
+
+    def one(key_r):
+        log_w0 = jnp.zeros((), jnp.float32)
+        nxt0 = jnp.asarray(k, count_dtype)
+        # initial W/next draw, keyed on index 0 (construction-time advance,
+        # Sampler.scala:207)
+        _, log_w, nxt = _advance(log_w0, nxt0, key_r, jnp.asarray(0, count_dtype), k)
+        return log_w, nxt
+
+    log_w, nxt = jax.vmap(one)(keys)
+    return ReservoirState(
+        samples=jnp.zeros((num_reservoirs, k), sample_dtype),
+        count=jnp.zeros((num_reservoirs,), count_dtype),
+        nxt=nxt,
+        log_w=log_w,
+        key=keys,
+    )
+
+
+def _accept_loop(
+    samples: jax.Array,
+    count: jax.Array,
+    nxt: jax.Array,
+    log_w: jax.Array,
+    key: jax.Array,
+    batch: jax.Array,
+    end: jax.Array,
+    k: int,
+    map_fn: Optional[Callable],
+):
+    """Process every acceptance landing in ``(count, end]`` for one reservoir.
+
+    The vmapped ``while_loop`` runs until the slowest lane is done; lanes with
+    no acceptance in the tile cost one compare (the hot-path property,
+    ``Sampler.scala:257``).
+    """
+
+    def cond(carry):
+        _, nxt_c, _ = carry
+        return nxt_c <= end
+
+    def body(carry):
+        samples_c, nxt_c, log_w_c = carry
+        pos = (nxt_c - count - 1).astype(jnp.int32)  # local index in [0, B)
+        elem = batch[pos]  # OOB-clamped gather is discarded for done lanes
+        slot, log_w_n, nxt_n = _advance(log_w_c, nxt_c, key, nxt_c, k)
+        value = map_fn(elem) if map_fn is not None else elem
+        samples_n = samples_c.at[slot].set(jnp.asarray(value, samples_c.dtype))
+        return samples_n, nxt_n, log_w_n
+
+    samples, nxt, log_w = jax.lax.while_loop(cond, body, (samples, nxt, log_w))
+    return samples, nxt, log_w
+
+
+def _update_one(
+    state_samples,
+    state_count,
+    state_nxt,
+    state_log_w,
+    state_key,
+    batch,
+    valid,
+    k: int,
+    map_fn: Optional[Callable],
+    fill: bool,
+):
+    """Single-reservoir tile update (vmapped over R by :func:`update`)."""
+    count_dtype = state_count.dtype
+    bsz = batch.shape[0]
+    end = state_count + valid.astype(count_dtype)
+
+    samples = state_samples
+    if fill:
+        # fill phase (Sampler.scala:253-255): element with absolute index
+        # idx <= k goes to slot idx-1, in arrival order.  map applies on
+        # accept; fill elements are all accepted.
+        idx = state_count + jnp.arange(1, bsz + 1, dtype=count_dtype)
+        in_tile = jnp.arange(bsz) < valid
+        fill_mask = (idx <= k) & in_tile
+        dest = jnp.where(fill_mask, (idx - 1).astype(jnp.int32), k)  # k -> dropped
+        values = map_fn(batch) if map_fn is not None else batch
+        samples = samples.at[dest].set(
+            jnp.asarray(values, samples.dtype), mode="drop"
+        )
+
+    samples, nxt, log_w = _accept_loop(
+        samples,
+        state_count,
+        state_nxt,
+        state_log_w,
+        state_key,
+        batch,
+        end,
+        k,
+        map_fn,
+    )
+    return samples, end, nxt, log_w
+
+
+def _update(
+    state: ReservoirState,
+    batch: jax.Array,
+    valid: Optional[jax.Array],
+    map_fn: Optional[Callable],
+    fill: bool,
+) -> ReservoirState:
+    k = state.k
+    if valid is None:
+        # Full tiles: broadcast a scalar down the vmap instead of materializing
+        # a [R] constant — keeps sharding propagation trivial on meshes.
+        valid_arg = jnp.asarray(batch.shape[1], jnp.int32)
+        in_axes = (0, 0, 0, 0, 0, 0, None)
+    else:
+        valid_arg = valid
+        in_axes = (0, 0, 0, 0, 0, 0, 0)
+    samples, count, nxt, log_w = jax.vmap(
+        functools.partial(_update_one, k=k, map_fn=map_fn, fill=fill),
+        in_axes=in_axes,
+    )(state.samples, state.count, state.nxt, state.log_w, state.key, batch, valid_arg)
+    return ReservoirState(samples, count, nxt, log_w, state.key)
+
+
+def update(
+    state: ReservoirState,
+    batch: jax.Array,
+    valid: Optional[jax.Array] = None,
+    map_fn: Optional[Callable] = None,
+) -> ReservoirState:
+    """Consume one ``[R, B]`` tile: reservoir r takes ``batch[r, :valid[r]]``.
+
+    Pure function — jit/vmap/shard_map freely.  ``valid`` (default: full
+    tiles) supports ragged feeds; padding elements are never sampled.
+    ``map_fn`` must be traceable; it is applied to accepted elements (tile-
+    vectorized during fill).
+    """
+    return _update(state, batch, valid, map_fn, fill=True)
+
+
+def update_steady(
+    state: ReservoirState,
+    batch: jax.Array,
+    valid: Optional[jax.Array] = None,
+    map_fn: Optional[Callable] = None,
+) -> ReservoirState:
+    """:func:`update` minus the fill-phase scatter — the steady-state fast
+    path once every reservoir holds k elements (callers check ``count >= k``;
+    the engine does this automatically).  Skipping the masked fill scatter
+    saves a [B]-wide scatter per reservoir per tile."""
+    return _update(state, batch, valid, map_fn, fill=False)
+
+
+def result(state: ReservoirState) -> Tuple[jax.Array, jax.Array]:
+    """Device-side result: ``(samples [R, k], size [R])`` where
+    ``size = min(count, k)`` (truncation contract, ``Sampler.scala:318-331``).
+    Host wrappers slice ``samples[r, :size[r]]``; entries beyond ``size`` are
+    zeros, never sampled data."""
+    size = jnp.minimum(state.count, state.k).astype(state.count.dtype)
+    mask = jnp.arange(state.k)[None, :] < size[:, None]
+    return jnp.where(mask, state.samples, jnp.zeros_like(state.samples)), size

@@ -1,0 +1,45 @@
+"""Counter-based RNG draws for the device kernels.
+
+The reference threads one sequential ``scala.util.Random`` through the hot
+path (``Sampler.scala:199, 228-236``), which is why its determinism tests must
+force RNG state by reflection (``SamplerTest.scala:16-54``).  Here every
+acceptance event draws from a key derived *by counter* — ``fold_in(key, idx)``
+where ``idx`` is the absolute 1-based stream index of the accepted element.
+
+That single design choice buys the framework's central invariant for free:
+the draws consumed by an acceptance depend only on (reservoir key, absolute
+index), never on how the stream was batched.  Feeding one element at a time,
+tiles of 1024, or any ragged split produces bit-identical reservoirs — the
+TPU-native analog of the reference's ``sample == sampleAll`` contract
+(``SamplerTest.scala:117-142``), with no reflection needed.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import jax.random as jr
+
+__all__ = ["accept_draws"]
+
+_INV_2_24 = float(2.0**-24)
+
+
+def accept_draws(key: jax.Array, idx: jax.Array, k: int):
+    """Draws consumed by the acceptance at absolute stream index ``idx``.
+
+    Returns ``(slot, u1, u2)``:
+
+    - ``slot``: uniform in ``[0, k)`` — the reservoir slot to overwrite
+      (``Sampler.scala:244``).  Modulo reduction of 32 random bits: *exact*
+      for power-of-two ``k``, bias ``< k/2^32`` otherwise.
+    - ``u1``, ``u2``: float32 uniforms in ``(0, 1]`` (24-bit mantissa grid,
+      exact in f32) feeding the Algorithm-L ``W``/skip update
+      (``Sampler.scala:228-236``).  The half-open-at-zero range keeps
+      ``log(u)`` finite.
+    """
+    bits = jr.bits(jr.fold_in(key, idx), (3,), jnp.uint32)
+    u1 = ((bits[0] >> 8).astype(jnp.float32) + 1.0) * _INV_2_24
+    u2 = ((bits[1] >> 8).astype(jnp.float32) + 1.0) * _INV_2_24
+    slot = (bits[2] % jnp.uint32(k)).astype(jnp.int32)
+    return slot, u1, u2

@@ -76,10 +76,14 @@ class AlgorithmLOracle:
         self._k = validate_max_sample_size(int(k))
         self._rng = rng
         self._map = map_fn if map_fn is not None else lambda x: x
-        # Growable buffer semantics (Sampler.scala:200-222). A Python list
-        # already grows geometrically; `pre_allocate` is kept for parity and
-        # exercised by allocating up front.
-        self._samples: List[Any] = [None] * self._k if pre_allocate else []
+        # Growable buffer semantics (Sampler.scala:200-222).  A Python list
+        # already grows geometrically, so `pre_allocate` is accepted for API
+        # parity but is behaviorally invisible (as in the reference — it only
+        # trades allocation pattern, never results).  We deliberately do NOT
+        # allocate k slots eagerly: k = MAX_SIZE is legal at construction
+        # (Sampler.scala:71) and must not commit ~17GB before any element
+        # arrives.  Device engines always pre-allocate (XLA static shapes).
+        self._samples: List[Any] = []
         self._pre_allocate = pre_allocate
         self._count: int = 0
         self._log_w: float = 0.0
@@ -109,10 +113,7 @@ class AlgorithmLOracle:
         self._advance()
 
     def _append(self, element: Any) -> None:
-        if self._pre_allocate:
-            self._samples[self._count - 1] = self._map(element)
-        else:
-            self._samples.append(self._map(element))
+        self._samples.append(self._map(element))
 
     # -- public per-element / bulk API ---------------------------------------
 

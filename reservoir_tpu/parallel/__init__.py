@@ -1,0 +1,23 @@
+"""Multi-chip scale: mesh sharding, collectives, reservoir merge.
+
+The reference has no distributed layer at all (SURVEY §2.4) — this package is
+the new first-class component: reservoir-axis data parallelism over a
+``jax.sharding.Mesh``, XLA collectives over ICI/DCN for result gathers, and
+stream-axis parallelism via mergeable reservoir summaries.
+"""
+
+from .sharded import (
+    make_mesh,
+    reservoir_sharding,
+    shard_state,
+    sharded_update,
+    sharded_result,
+)
+
+__all__ = [
+    "make_mesh",
+    "reservoir_sharding",
+    "shard_state",
+    "sharded_update",
+    "sharded_result",
+]

@@ -1,0 +1,132 @@
+"""M2 API-layer tests: factory validation + lifecycle matrix.
+
+Mirrors ``SamplerTest.scala``'s shared-behavior groups ``singleUseSampler``
+(:243-268), ``reusableSampler`` (:270-317) and the validation cases (:73-79),
+applied across the factory matrix {duplicates, duplicates+preAllocate,
+distinct} x {single-use, reusable} (cf. ``:341-369``).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from reservoir_tpu import MAX_SIZE, SamplerClosedError
+from reservoir_tpu.api import distinct, sampler
+
+FACTORIES = {
+    "dup": lambda k, **kw: sampler(k, **kw),
+    "dup_prealloc": lambda k, **kw: sampler(k, pre_allocate=True, **kw),
+    "distinct": lambda k, **kw: distinct(k, **kw),
+}
+
+
+@pytest.mark.parametrize("make", FACTORIES.values(), ids=FACTORIES.keys())
+class TestValidation:
+    """Validation is eager, at construction (``Sampler.scala:79-95``)."""
+
+    def test_negative_k(self, make):
+        with pytest.raises(ValueError):
+            make(-1)
+
+    def test_zero_k(self, make):
+        with pytest.raises(ValueError):
+            make(0)
+
+    def test_k_too_large(self, make):
+        with pytest.raises(ValueError):
+            make(MAX_SIZE + 1)
+
+    def test_k_max_ok(self, make):
+        # MAX_SIZE itself is legal (Sampler.scala:71) — construction only;
+        # nothing forces allocation until elements arrive.
+        s = make(MAX_SIZE)
+        assert s.is_open
+
+    def test_bad_map(self, make):
+        with pytest.raises(TypeError):
+            make(5, map_fn="not callable")
+
+
+def test_distinct_requires_callable_hash():
+    with pytest.raises(TypeError):
+        distinct(5, hash_fn=42)
+
+
+@pytest.mark.parametrize("make", FACTORIES.values(), ids=FACTORIES.keys())
+class TestSingleUse:
+    """``singleUseSampler`` behaviors (``SamplerTest.scala:243-268``)."""
+
+    def test_throws_after_result(self, make):
+        s = make(4, rng=0)
+        s.sample_all(range(10))
+        s.result()
+        for op in (lambda: s.sample(1), lambda: s.sample_all([1]), s.result):
+            with pytest.raises(SamplerClosedError):
+                op()
+
+    def test_is_open_transitions(self, make):
+        s = make(4, rng=0)
+        assert s.is_open
+        s.sample(1)
+        assert s.is_open
+        s.result()
+        assert not s.is_open  # is_open stays callable after close (:193)
+
+
+@pytest.mark.parametrize("make", FACTORIES.values(), ids=FACTORIES.keys())
+class TestReusable:
+    """``reusableSampler`` behaviors (``SamplerTest.scala:270-317``)."""
+
+    def test_no_throw_on_reuse(self, make):
+        s = make(4, reusable=True, rng=0)
+        s.sample_all(range(10))
+        first = s.result()
+        s.sample_all(range(10, 20))
+        second = s.result()
+        assert s.is_open
+        assert len(first) == len(second) == 4
+
+    def test_snapshot_integrity(self, make):
+        # Interleave result() with more sampling; earlier snapshots must not
+        # be clobbered (copy-on-write proof, SamplerTest.scala:292-316).
+        s = make(8, reusable=True, rng=1)
+        s.sample_all(range(100))
+        snap1 = list(s.result())
+        frozen = list(snap1)
+        s.sample_all(range(100, 1000))
+        snap2 = list(s.result())
+        assert snap1 == frozen
+        assert len(snap2) == 8
+
+
+class TestSemantics:
+    def test_dup_vs_distinct_on_repeats(self):
+        # 10x the same value: dup mode yields ten 7s, distinct exactly one
+        # (SamplerTest.scala:319-339).
+        d = sampler(10, rng=0)
+        d.sample_all([7] * 10)
+        assert d.result() == [7] * 10
+        u = distinct(10, rng=0)
+        u.sample_all([7] * 10)
+        assert u.result() == [7]
+
+    def test_map_fn_dup(self):
+        s = sampler(4, map_fn=lambda x: x * 3, rng=2)
+        s.sample_all(range(50))
+        assert all(v % 3 == 0 for v in s.result())
+
+    def test_rng_reproducibility(self):
+        # Explicit seed -> identical samples, no reflection needed
+        # (the design answer to SamplerTest.scala:16-54).
+        a = sampler(8, rng=123)
+        a.sample_all(range(1000))
+        b = sampler(8, rng=123)
+        b.sample_all(range(1000))
+        assert a.result() == b.result()
+
+    def test_generator_instance_rng(self):
+        g = np.random.default_rng(5)
+        s = sampler(4, rng=g)
+        s.sample_all(range(20))
+        assert len(s.result()) == 4

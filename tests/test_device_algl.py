@@ -1,0 +1,203 @@
+"""M1 device-kernel tests: vmapped Algorithm L.
+
+The device analog of the reference's core suite (``SamplerTest.scala``), with
+two upgrades the TPU design buys (SURVEY §4.4): statistical tests run one
+vmapped pass over tens of thousands of reservoirs instead of sequential
+trials, and determinism needs no reflection — draws are counter-keyed, so
+tile-split invariance *is* the ``sample == sampleAll`` contract.
+"""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+import jax.random as jr
+
+from reservoir_tpu.ops import algorithm_l as al
+
+
+def feed(state, stream_2d, tile, valid=None, steady=False):
+    """Feed ``stream_2d [R, N]`` in tiles of ``tile`` columns."""
+    R, N = stream_2d.shape
+    fn = al.update_steady if steady else al.update
+    fn = jax.jit(fn)
+    for start in range(0, N, tile):
+        chunk = stream_2d[:, start : start + tile]
+        b = chunk.shape[1]
+        if b < tile:  # pad with garbage; mask via valid
+            pad = jnp.full((R, tile - b), -(10**9), stream_2d.dtype)
+            chunk = jnp.concatenate([chunk, pad], axis=1)
+            v = jnp.full((R,), b, jnp.int32)
+        else:
+            v = valid
+        state = fn(state, chunk, v) if v is not None else fn(state, chunk)
+    return state
+
+
+class TestDegenerate:
+    def test_n_less_than_k(self):
+        state = al.init(jr.key(0), 3, 8)
+        stream = jnp.arange(3 * 5, dtype=jnp.int32).reshape(3, 5)
+        state = al.update(state, stream)
+        samples, size = al.result(state)
+        assert np.all(np.asarray(size) == 5)
+        np.testing.assert_array_equal(np.asarray(samples)[:, :5], np.asarray(stream))
+
+    def test_n_equals_k_arrival_order(self):
+        state = al.init(jr.key(1), 2, 6)
+        stream = jnp.arange(12, dtype=jnp.int32).reshape(2, 6)
+        state = al.update(state, stream)
+        samples, size = al.result(state)
+        np.testing.assert_array_equal(np.asarray(samples), np.asarray(stream))
+
+    def test_empty_update(self):
+        state = al.init(jr.key(2), 2, 4)
+        out = al.update(state, jnp.zeros((2, 8), jnp.int32), jnp.zeros((2,), jnp.int32))
+        assert np.all(np.asarray(out.count) == 0)
+        _, size = al.result(out)
+        assert np.all(np.asarray(size) == 0)
+
+    def test_k_equals_one(self):
+        state = al.init(jr.key(3), 4, 1)
+        stream = jnp.arange(4 * 100, dtype=jnp.int32).reshape(4, 100)
+        state = al.update(state, stream)
+        _, size = al.result(state)
+        assert np.all(np.asarray(size) == 1)
+
+
+class TestTileSplitInvariance:
+    """The framework's sample == sampleAll: any stream partition, same bits."""
+
+    @pytest.mark.parametrize("tiles", [[1] * 40, [40], [16, 16, 8], [3, 17, 11, 9]])
+    def test_splits_bit_identical(self, tiles):
+        R, k, N = 8, 4, 40
+        stream = jnp.asarray(
+            np.random.default_rng(0).integers(0, 1 << 30, (R, N)), jnp.int32
+        )
+        ref = al.update(al.init(jr.key(7), R, k), stream)
+        state = al.init(jr.key(7), R, k)
+        start = 0
+        for b in tiles:
+            state = al.update(state, stream[:, start : start + b])
+            start += b
+        for a, b_ in zip(ref[:4], state[:4]):  # skip key field
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b_))
+
+    def test_ragged_valid_equals_exact_feed(self):
+        R, k = 4, 4
+        rng = np.random.default_rng(1)
+        lens = [5, 9, 2, 8]  # ragged per-reservoir feeds in one padded tile
+        B = 9
+        data = rng.integers(0, 1 << 30, (R, B)).astype(np.int32)
+        padded = data.copy()
+        for r, L in enumerate(lens):
+            padded[r, L:] = -(10**9)  # garbage beyond valid must never land
+        st_ragged = al.update(
+            al.init(jr.key(9), R, k), jnp.asarray(padded), jnp.asarray(lens, jnp.int32)
+        )
+        # reference: feed each reservoir exactly its valid prefix via B=1 steps
+        st_exact = al.init(jr.key(9), R, k)
+        for i in range(B):
+            v = jnp.asarray([1 if i < L else 0 for L in lens], jnp.int32)
+            st_exact = al.update(st_exact, jnp.asarray(data[:, i : i + 1]), v)
+        for a, b_ in zip(st_ragged[:4], st_exact[:4]):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b_))
+        assert not np.any(np.asarray(st_ragged.samples) == -(10**9))
+
+    def test_steady_path_matches_general(self):
+        R, k, B = 8, 16, 64
+        stream = jnp.asarray(
+            np.random.default_rng(3).integers(0, 1 << 30, (R, 4 * B)), jnp.int32
+        )
+        st = al.update(al.init(jr.key(5), R, k), stream[:, :B])  # fill done (B>k)
+        a = al.update(st, stream[:, B:])
+        b = al.update_steady(st, stream[:, B:])
+        for x, y in zip(a[:4], b[:4]):
+            np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+class TestMap:
+    def test_map_applied_on_accept(self):
+        R, k = 4, 8
+        stream = jnp.arange(R * 100, dtype=jnp.int32).reshape(R, 100)
+        state = al.update(al.init(jr.key(11), R, k), stream, map_fn=lambda x: x * 2)
+        samples, _ = al.result(state)
+        assert np.all(np.asarray(samples) % 2 == 0)
+        # same selection as unmapped run under the same key (map must not
+        # perturb the RNG stream — invariant 5's device analog)
+        plain = al.update(al.init(jr.key(11), R, k), stream)
+        psamples, _ = al.result(plain)
+        np.testing.assert_array_equal(np.asarray(samples), np.asarray(psamples) * 2)
+
+
+class TestStatistics:
+    def test_uniformity_5_sigma(self):
+        # R reservoirs = R independent trials in ONE vmapped pass.
+        R, n, k = 40_000, 10, 5
+        stream = jnp.tile(jnp.arange(n, dtype=jnp.int32), (R, 1))
+        state = al.update(al.init(jr.key(42), R, k), stream)
+        samples, size = al.result(state)
+        assert np.all(np.asarray(size) == k)
+        counts = np.bincount(np.asarray(samples).ravel(), minlength=n)
+        expected = R * k / n
+        sigma = math.sqrt(R * 0.5 * 0.5)
+        assert np.all(np.abs(counts - expected) < 5 * sigma), counts
+
+    def test_pairwise_independence_5_sigma(self):
+        R, n, k = 40_000, 10, 5
+        stream = jnp.tile(jnp.arange(n, dtype=jnp.int32), (R, 1))
+        state = al.update(al.init(jr.key(43), R, k), stream)
+        samples, _ = al.result(state)
+        members = np.zeros((R, n), dtype=bool)
+        rows = np.repeat(np.arange(R), k)
+        members[rows, np.asarray(samples).ravel()] = True
+        m = members.astype(np.int64)
+        agree = np.einsum("ri,rj->ij", m, m) + np.einsum(
+            "ri,rj->ij", 1 - m, 1 - m
+        )
+        p = 4.0 / 9.0
+        sigma = math.sqrt(R * p * (1 - p))
+        off = ~np.eye(n, dtype=bool)
+        assert np.all(np.abs(agree[off] - R * p) < 5 * sigma)
+
+    def test_ks_distance_vs_oracle(self):
+        # BASELINE gate: two-sample KS distance between device-sampled index
+        # distribution and the CPU oracle's, < 1% (BASELINE.md north star).
+        from reservoir_tpu.oracle import AlgorithmLOracle
+
+        R, n, k = 2_048, 1_000, 32
+        stream = jnp.tile(jnp.arange(n, dtype=jnp.int32), (R, 1))
+        state = feed(al.init(jr.key(44), R, k), stream, tile=256)
+        samples, _ = al.result(state)
+        dev = np.sort(np.asarray(samples).ravel())
+
+        cpu = []
+        for seed in range(512):
+            o = AlgorithmLOracle(k, np.random.default_rng(seed))
+            o.sample_all(range(n))
+            cpu.extend(o.result())
+        cpu = np.sort(np.asarray(cpu))
+
+        grid = np.arange(n)
+        f_dev = np.searchsorted(dev, grid, side="right") / dev.size
+        f_cpu = np.searchsorted(cpu, grid, side="right") / cpu.size
+        ks = np.max(np.abs(f_dev - f_cpu))
+        assert ks < 0.01, ks
+
+
+class TestCountSaturation:
+    def test_nxt_saturates_no_wraparound(self):
+        # Force nxt near dtype max and confirm no overflow/wraparound.
+        state = al.init(jr.key(1), 2, 2)
+        big = np.iinfo(np.int32).max - 5
+        state = state._replace(
+            count=jnp.full((2,), big, jnp.int32),
+            nxt=jnp.full((2,), big + 1, jnp.int32),
+        )
+        out = al.update_steady(state, jnp.ones((2, 4), jnp.int32))
+        assert np.all(np.asarray(out.nxt) >= np.asarray(out.count))

@@ -1,0 +1,77 @@
+"""Multi-chip sharding tests on the virtual 8-device CPU mesh (SURVEY §4.4).
+
+The reference offers no distributed pattern to mirror (SURVEY §2.4); the
+invariant these tests pin down is ours: sharding the reservoir axis over a
+mesh changes WHERE reservoirs live, never WHAT they sample — results must be
+bit-identical to the single-device run under the same keys.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+import jax.random as jr
+
+from reservoir_tpu.ops import algorithm_l as al
+from reservoir_tpu.parallel import (
+    make_mesh,
+    reservoir_sharding,
+    shard_state,
+    sharded_result,
+    sharded_update,
+)
+
+pytestmark = pytest.mark.skipif(
+    len(jax.devices()) < 8, reason="needs 8 (virtual) devices"
+)
+
+
+def test_sharded_update_bit_identical_to_single_device():
+    R, k, B = 64, 8, 32
+    mesh = make_mesh(8)
+    stream = np.random.default_rng(0).integers(0, 1 << 30, (R, 3 * B)).astype(np.int32)
+
+    # single-device reference
+    ref = al.init(jr.key(5), R, k)
+    for t in range(3):
+        ref = al.update(ref, jnp.asarray(stream[:, t * B : (t + 1) * B]))
+    ref_samples, ref_sizes = al.result(ref)
+
+    # sharded run
+    state = shard_state(al.init(jr.key(5), R, k), mesh)
+    upd = sharded_update(mesh)
+    sh = reservoir_sharding(mesh)
+    for t in range(3):
+        tile = jax.device_put(
+            jnp.asarray(stream[:, t * B : (t + 1) * B]),
+            jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec("res", None)),
+        )
+        state = upd(state, tile)
+    samples, sizes, total = sharded_result(mesh)(state)
+
+    np.testing.assert_array_equal(np.asarray(samples), np.asarray(ref_samples))
+    np.testing.assert_array_equal(np.asarray(sizes), np.asarray(ref_sizes))
+    assert int(total) == R * 3 * B
+
+
+def test_sharded_state_actually_sharded():
+    mesh = make_mesh(8)
+    state = shard_state(al.init(jr.key(0), 64, 4), mesh)
+    assert len(state.samples.sharding.device_set) == 8
+    # each device holds exactly its 1/8 shard of the reservoir axis
+    shard_shapes = {s.data.shape for s in state.samples.addressable_shards}
+    assert shard_shapes == {(8, 4)}
+
+
+def test_steady_sharded_path():
+    R, k, B = 32, 4, 16
+    mesh = make_mesh(8)
+    spec = jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec("res", None))
+    state = shard_state(al.init(jr.key(2), R, k), mesh)
+    tile = jax.device_put(jnp.ones((R, B), jnp.int32), spec)
+    state = sharded_update(mesh)(state, tile)  # fill
+    state = sharded_update(mesh, steady=True)(state, tile)
+    assert np.all(np.asarray(state.count) == 2 * B)
