@@ -17,8 +17,9 @@ own stream.  The engine owns:
   lower bound on ``count`` (no device sync) decides when the fill-phase
   scatter can be dropped from the compiled program.
 
-Distinct and weighted configs are rejected here for now; their device engines
-arrive with SURVEY §7.2 M3/M6 and will share this lifecycle surface.
+``SamplerConfig(distinct=True)`` selects the bottom-k kernel of
+:mod:`reservoir_tpu.ops.distinct` behind the same surface; weighted mode
+arrives with SURVEY §7.2 M6.
 """
 
 from __future__ import annotations
@@ -34,6 +35,7 @@ import numpy as np
 from .config import SamplerConfig, validate_max_sample_size
 from .errors import SamplerClosedError
 from .ops import algorithm_l as _algl
+from .ops import distinct as _distinct
 
 __all__ = ["ReservoirEngine"]
 
@@ -42,10 +44,13 @@ class ReservoirEngine:
     """R independent k-reservoirs updated in lockstep on device.
 
     Args:
-      config: engine configuration (k, R, dtypes, tile size).
+      config: engine configuration (k, R, dtypes, tile size, distinct).
       key: JAX PRNG key (or ``seed`` int).  Explicit-by-construction
         reproducibility (``SamplerTest.scala:16-54``'s lesson).
-      map_fn: traceable map applied on accept (``Sampler.scala:116``).
+      map_fn: traceable map; applied on accept in duplicates mode
+        (``Sampler.scala:116``), to every element in distinct mode (``:155``).
+      hash_fn: distinct mode only — traceable tile hash returning a
+        ``(hi, lo)`` uint32 pair (``Sampler.distinct``'s hash hook, ``:173``).
       reusable: reference lifecycle switch (``Sampler.scala:130-136``);
         single-use engines free device buffers on ``result()``.
     """
@@ -55,20 +60,23 @@ class ReservoirEngine:
         config: SamplerConfig,
         key: Union[int, jax.Array, None] = None,
         map_fn: Optional[Callable] = None,
+        hash_fn: Optional[Callable] = None,
         reusable: bool = False,
     ) -> None:
         validate_max_sample_size(config.max_sample_size)
-        if config.distinct or config.weighted:
-            raise NotImplementedError(
-                "use DistinctEngine / WeightedEngine for those modes"
-            )
+        if config.weighted:
+            raise NotImplementedError("weighted mode arrives with M6")
         self._config = config
         self._map_fn = map_fn
+        self._hash_fn = hash_fn
         self._reusable = reusable
         self._open = True
+        if hash_fn is not None and not config.distinct:
+            raise ValueError("hash_fn is only meaningful with distinct=True")
+        self._ops = _distinct if config.distinct else _algl
         if key is None or isinstance(key, int):
             key = jr.key(0 if key is None else key)
-        self._state = _algl.init(
+        self._state = self._ops.init(
             key,
             config.num_reservoirs,
             config.max_sample_size,
@@ -94,8 +102,9 @@ class ReservoirEngine:
         return True if self._reusable else self._open
 
     @property
-    def state(self) -> _algl.ReservoirState:
-        """A snapshot of the state pytree.  Copied, because the engine's
+    def state(self) -> Union[_algl.ReservoirState, _distinct.DistinctState]:
+        """A snapshot of the state pytree (``ReservoirState`` in duplicates
+        mode, ``DistinctState`` in distinct mode).  Copied, because the engine's
         jitted updates donate the previous state's buffers (the streaming
         fast path) — handing out the live buffers would let a later
         ``sample()`` delete them out from under the caller."""
@@ -116,9 +125,12 @@ class ReservoirEngine:
         cache_key = (width, steady)
         fn = self._jit_cache.get(cache_key)
         if fn is None:
-            base = _algl.update_steady if steady else _algl.update
+            base = self._ops.update_steady if steady else self._ops.update
+            kwargs = {"map_fn": self._map_fn}
+            if self._config.distinct:
+                kwargs["hash_fn"] = self._hash_fn
             fn = jax.jit(
-                functools.partial(base, map_fn=self._map_fn),
+                functools.partial(base, **kwargs),
                 donate_argnums=(0,),
             )
             self._jit_cache[cache_key] = fn
@@ -135,7 +147,12 @@ class ReservoirEngine:
                 f"got {tile.shape}"
             )
         width = tile.shape[1]
-        steady = self._min_count >= self._config.max_sample_size
+        # distinct mode has one code path (update_steady is update); collapse
+        # the cache key so crossing the fill boundary never recompiles
+        steady = (
+            not self._config.distinct
+            and self._min_count >= self._config.max_sample_size
+        )
         fn = self._update_fn(width, steady)
         if valid is None:
             self._state = fn(self._state, tile)
@@ -192,7 +209,7 @@ class ReservoirEngine:
         are immutable (the copy-on-write guarantee of ``Sampler.scala:353-381``
         holds structurally)."""
         self._check_open()
-        samples, sizes = _algl.result(self._state)
+        samples, sizes = self._ops.result(self._state)
         out = (np.asarray(samples), np.asarray(sizes))
         if not self._reusable:
             self._open = False

@@ -1,0 +1,211 @@
+"""Distinct-value sampling on device: salted bottom-k via XLA sorts (M3).
+
+The reference's ``RandomValues`` engine (``Sampler.scala:383-412``) keeps the
+k distinct values with the smallest salted 64-bit hashes using a max-heap +
+membership set.  Pointer-chasing heaps and hash sets have no TPU analog
+(SURVEY §7.3 "Distinct mode without hash tables"); the device design exploits
+that bottom-k-of-a-hash is a *mergeable summary*:
+
+    state (k entries) ∪ tile (B entries)  --sort+dedup+truncate-->  state'
+
+Per tile and reservoir: scramble the tile's hashes (same integer-exact
+:func:`~reservoir_tpu.ops.hashing.scramble64` as the CPU oracle — results are
+bit-comparable), concatenate with the carried entries, multi-key sort
+``(pad, hash_hi, hash_lo, value)``, mask duplicate runs, re-sort survivors,
+keep the k smallest.  Two ``lax.sort`` passes of k+B lanes replace the
+reference's per-element heap ops; a whole tile costs O((k+B) log(k+B))
+comparisons regardless of duplication structure.
+
+Semantics preserved (SURVEY §2.2 invariant 6): inclusion is uniform over
+distinct values via the salted hash order; dedup is by value (equal values
+have equal hashes and collapse to one entry).  Two *distinct* values
+colliding in the full 64-bit hash are both kept — same as the reference,
+whose membership set is keyed on value while only the threshold uses the
+hash (``Sampler.scala:396-408``); hash-order ties are the shared ~2^-64
+bias source.  ``map`` applies to every element (it feeds the hash,
+``Sampler.scala:155, 395``).  Tile-split invariance holds because the merge
+is associative and order-insensitive.
+
+Sample dtype must be a 32-bit integer type for now: the default hash and the
+dedup key embed the value's 4-byte pattern (validated at :func:`init`).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import jax.random as jr
+import numpy as np
+
+from .hashing import default_hash64, scramble64
+
+__all__ = ["DistinctState", "init", "update", "update_steady", "result"]
+
+_U32_MAX = jnp.uint32(0xFFFFFFFF)
+
+
+class DistinctState(NamedTuple):
+    """R lockstep distinct-value reservoirs.
+
+    Entries ``[r, i]`` for ``i < size[r]`` are the current bottom-k, sorted by
+    scrambled hash ascending; the rest are canonical padding (hash = MAX,
+    value = 0) marked by ``size``.
+    """
+
+    values: jax.Array  # [R, k] sample dtype
+    hash_hi: jax.Array  # [R, k] uint32
+    hash_lo: jax.Array  # [R, k] uint32
+    size: jax.Array  # [R] int32
+    count: jax.Array  # [R] count dtype — total elements seen
+    salts: jax.Array  # [R, 4] uint32 — (r0_hi, r0_lo, r1_hi, r1_lo)
+
+
+def init(
+    key: jax.Array,
+    num_reservoirs: int,
+    k: int,
+    sample_dtype: Any = jnp.int32,
+    count_dtype: Any = jnp.int32,
+) -> DistinctState:
+    """Empty reservoirs with per-instance salts drawn once
+    (``Sampler.scala:385-388``)."""
+    sample_dtype = jnp.dtype(sample_dtype)
+    if not (
+        jnp.issubdtype(sample_dtype, jnp.integer) and sample_dtype.itemsize == 4
+    ):
+        raise ValueError(
+            "distinct mode currently requires a 32-bit integer sample dtype "
+            f"(value bits feed the hash and dedup key); got {sample_dtype}"
+        )
+    salts = jr.bits(key, (num_reservoirs, 4), jnp.uint32)
+    return DistinctState(
+        values=jnp.zeros((num_reservoirs, k), sample_dtype),
+        hash_hi=jnp.full((num_reservoirs, k), _U32_MAX),
+        hash_lo=jnp.full((num_reservoirs, k), _U32_MAX),
+        size=jnp.zeros((num_reservoirs,), jnp.int32),
+        count=jnp.zeros((num_reservoirs,), count_dtype),
+        salts=salts,
+    )
+
+
+def _update_one(
+    values,
+    hash_hi,
+    hash_lo,
+    size,
+    count,
+    salts,
+    batch,
+    valid,
+    k: int,
+    map_fn: Optional[Callable],
+    hash_fn: Optional[Callable],
+):
+    """Single-reservoir tile merge (vmapped over R)."""
+    bsz = batch.shape[0]
+    mapped = map_fn(batch) if map_fn is not None else batch  # every element
+    if hash_fn is not None:
+        bhi, blo = hash_fn(mapped)
+    else:
+        bhi, blo = default_hash64(mapped)
+    bhi, blo = scramble64(
+        bhi.astype(jnp.uint32),
+        blo.astype(jnp.uint32),
+        salts[0],
+        salts[1],
+        salts[2],
+        salts[3],
+    )
+
+    in_tile = jnp.arange(bsz) < valid
+    # pad key: carried padding (>= size) and masked tile lanes sort last
+    carried_pad = (jnp.arange(k) >= size).astype(jnp.uint32)
+    tile_pad = (~in_tile).astype(jnp.uint32)
+
+    m_values = jnp.concatenate([values, jnp.asarray(mapped, values.dtype)])
+    m_hi = jnp.concatenate([hash_hi, bhi])
+    m_lo = jnp.concatenate([hash_lo, blo])
+    m_pad = jnp.concatenate([carried_pad, tile_pad])
+    # stable sortable view of the value for tie-grouping (dedup key);
+    # init() guarantees a 4-byte integer dtype
+    m_vbits = m_values.view(jnp.uint32)
+
+    # sort by (pad, hash, value-bits): equal values -> equal hashes -> adjacent
+    m_pad, m_hi, m_lo, m_vbits, m_values = jax.lax.sort(
+        (m_pad, m_hi, m_lo, m_vbits, m_values), num_keys=4
+    )
+    same_as_prev = (
+        (m_pad == jnp.roll(m_pad, 1))
+        & (m_hi == jnp.roll(m_hi, 1))
+        & (m_lo == jnp.roll(m_lo, 1))
+        & (m_vbits == jnp.roll(m_vbits, 1))
+    )
+    same_as_prev = same_as_prev.at[0].set(False)
+    dup_or_pad = same_as_prev | (m_pad == 1)
+
+    # demote duplicates and padding to canonical padding, re-sort, keep k
+    m_hi = jnp.where(dup_or_pad, _U32_MAX, m_hi)
+    m_lo = jnp.where(dup_or_pad, _U32_MAX, m_lo)
+    m_pad2 = dup_or_pad.astype(jnp.uint32)
+    m_values = jnp.where(dup_or_pad, jnp.zeros((), m_values.dtype), m_values)
+    m_pad2, m_hi, m_lo, m_values = jax.lax.sort(
+        (m_pad2, m_hi, m_lo, m_values), num_keys=3
+    )
+
+    new_values = m_values[:k]
+    new_hi = m_hi[:k]
+    new_lo = m_lo[:k]
+    n_unique = jnp.sum(1 - m_pad2).astype(jnp.int32)
+    new_size = jnp.minimum(n_unique, k)
+    new_count = count + valid.astype(count.dtype)
+    return new_values, new_hi, new_lo, new_size, new_count
+
+
+def update(
+    state: DistinctState,
+    batch: jax.Array,
+    valid: Optional[jax.Array] = None,
+    map_fn: Optional[Callable] = None,
+    hash_fn: Optional[Callable] = None,
+) -> DistinctState:
+    """Merge one ``[R, B]`` tile into the bottom-k state.
+
+    ``hash_fn`` (optional) maps a mapped-value tile to a ``(hi, lo)`` uint32
+    pair *before* salting — the user-hash hook of ``Sampler.distinct``
+    (``Sampler.scala:173-180``); default embeds int32 values sign-extended.
+    """
+    k = state.values.shape[1]
+    if valid is None:
+        valid_arg = jnp.asarray(batch.shape[1], jnp.int32)
+        in_axes = (0, 0, 0, 0, 0, 0, 0, None)
+    else:
+        valid_arg = valid
+        in_axes = (0, 0, 0, 0, 0, 0, 0, 0)
+    values, hi, lo, size, count = jax.vmap(
+        functools.partial(_update_one, k=k, map_fn=map_fn, hash_fn=hash_fn),
+        in_axes=in_axes,
+    )(
+        state.values,
+        state.hash_hi,
+        state.hash_lo,
+        state.size,
+        state.count,
+        state.salts,
+        batch,
+        valid_arg,
+    )
+    return DistinctState(values, hi, lo, size, count, state.salts)
+
+
+#: Distinct mode has no fill/steady split — the merge is one code path.
+update_steady = update
+
+
+def result(state: DistinctState) -> Tuple[jax.Array, jax.Array]:
+    """``(values [R, k], size [R])``, sorted by scrambled hash ascending —
+    the order the contract leaves unspecified (``Sampler.scala:411``), made
+    canonical (and oracle-comparable) here."""
+    return state.values, state.size

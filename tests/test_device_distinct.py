@@ -1,0 +1,170 @@
+"""M3 device distinct-sampler tests: sort-based bottom-k vs the CPU oracle.
+
+Distinct selection is integer-only, so unlike duplicates mode the device
+kernel is *bit-comparable* with the oracle given the same salts — the
+strongest parity check in the suite.
+"""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+import jax.random as jr
+
+from reservoir_tpu import SamplerConfig
+from reservoir_tpu.engine import ReservoirEngine
+from reservoir_tpu.ops import distinct as dd
+from reservoir_tpu.oracle import BottomKOracle
+
+
+def with_salts(state, salts_64):
+    """Inject oracle-style (r0, r1) 64-bit salts into every reservoir."""
+    r0, r1 = salts_64
+    row = np.array(
+        [(r0 >> 32) & 0xFFFFFFFF, r0 & 0xFFFFFFFF, (r1 >> 32) & 0xFFFFFFFF, r1 & 0xFFFFFFFF],
+        dtype=np.uint32,
+    )
+    R = state.salts.shape[0]
+    return state._replace(salts=jnp.asarray(np.tile(row, (R, 1))))
+
+
+SALTS = (0x0123456789ABCDEF, 0xFEDCBA9876543210)
+
+
+class TestOracleBitParity:
+    @pytest.mark.parametrize("k,n", [(8, 100), (32, 1000), (4, 7)])
+    def test_device_equals_oracle(self, k, n):
+        rng = np.random.default_rng(0)
+        stream = rng.integers(-(1 << 31), 1 << 31, n).astype(np.int32)
+        o = BottomKOracle(k, rng, salts=SALTS)
+        o.sample_all(int(x) for x in stream)
+        state = with_salts(dd.init(jr.key(0), 1, k), SALTS)
+        state = dd.update(state, jnp.asarray(stream)[None, :])
+        values, size = dd.result(state)
+        dev = list(np.asarray(values)[0, : int(size[0])])
+        assert [int(v) for v in dev] == [int(v) for v in o.result()]
+
+    def test_heavy_duplication(self):
+        k = 8
+        stream = np.array([x % 20 for x in range(500)], dtype=np.int32)
+        rng = np.random.default_rng(1)
+        o = BottomKOracle(k, rng, salts=SALTS)
+        o.sample_all(int(x) for x in stream)
+        state = with_salts(dd.init(jr.key(1), 1, k), SALTS)
+        state = dd.update(state, jnp.asarray(stream)[None, :])
+        values, size = dd.result(state)
+        assert list(np.asarray(values)[0, : int(size[0])]) == [int(v) for v in o.result()]
+
+
+class TestTileSplitInvariance:
+    @pytest.mark.parametrize("tiles", [[1] * 30, [30], [7, 13, 10]])
+    def test_splits_identical(self, tiles):
+        R, k = 4, 6
+        stream = np.random.default_rng(2).integers(0, 50, (R, 30)).astype(np.int32)
+        ref = dd.update(dd.init(jr.key(3), R, k), jnp.asarray(stream))
+        state = dd.init(jr.key(3), R, k)
+        start = 0
+        for b in tiles:
+            state = dd.update(state, jnp.asarray(stream[:, start : start + b]))
+            start += b
+        np.testing.assert_array_equal(np.asarray(ref.values), np.asarray(state.values))
+        np.testing.assert_array_equal(np.asarray(ref.size), np.asarray(state.size))
+        np.testing.assert_array_equal(np.asarray(ref.count), np.asarray(state.count))
+
+    def test_valid_masking(self):
+        R, k, B = 3, 4, 10
+        data = np.random.default_rng(3).integers(0, 1000, (R, B)).astype(np.int32)
+        lens = [4, 10, 0]
+        padded = data.copy()
+        for r, L in enumerate(lens):
+            padded[r, L:] = 999_999  # sentinel must never be sampled
+        st = dd.update(
+            dd.init(jr.key(4), R, k), jnp.asarray(padded), jnp.asarray(lens, jnp.int32)
+        )
+        # reservoir 2 got nothing
+        assert int(st.size[2]) == 0 and int(st.count[2]) == 0
+        assert not np.any(np.asarray(st.values) == 999_999)
+        assert int(st.count[0]) == 4 and int(st.count[1]) == 10
+
+
+class TestSemantics:
+    def test_dedup_to_single_value(self):
+        state = dd.init(jr.key(5), 2, 5)
+        state = dd.update(state, jnp.full((2, 50), 7, jnp.int32))
+        values, size = dd.result(state)
+        assert np.all(np.asarray(size) == 1)
+        assert np.all(np.asarray(values)[:, 0] == 7)
+
+    def test_fewer_distinct_than_k(self):
+        state = dd.init(jr.key(6), 1, 50)
+        state = dd.update(state, jnp.asarray([[1, 2, 3, 2, 1, 3, 3]], jnp.int32))
+        values, size = dd.result(state)
+        assert int(size[0]) == 3
+        assert sorted(np.asarray(values)[0, :3].tolist()) == [1, 2, 3]
+
+    def test_map_fn_applied_every_element(self):
+        # map x -> x % 10 collapses the stream to 10 distinct values
+        state = dd.init(jr.key(7), 1, 32)
+        state = dd.update(
+            state,
+            jnp.arange(1000, dtype=jnp.int32)[None, :],
+            map_fn=lambda x: x % 10,
+        )
+        values, size = dd.result(state)
+        assert int(size[0]) == 10
+        assert sorted(np.asarray(values)[0, :10].tolist()) == list(range(10))
+
+    def test_negative_values_sign_extension_matches_oracle(self):
+        stream = np.array([-5, -1, 3, -5, 7], dtype=np.int32)
+        rng = np.random.default_rng(8)
+        o = BottomKOracle(3, rng, salts=SALTS)
+        o.sample_all(int(x) for x in stream)
+        state = with_salts(dd.init(jr.key(8), 1, 3), SALTS)
+        state = dd.update(state, jnp.asarray(stream)[None, :])
+        values, size = dd.result(state)
+        assert list(np.asarray(values)[0, : int(size[0])]) == [int(v) for v in o.result()]
+
+
+class TestStatistics:
+    def test_uniform_over_distinct_values_zipf(self):
+        # Zipf-skewed duplication must not bias selection (BASELINE config 3
+        # shape, scaled down): every distinct value equally likely.
+        R, k, n_vals = 20_000, 5, 10
+        rng = np.random.default_rng(9)
+        # Zipf-1.1-ish skew: value v appears ~1/(v+1)^1.1 of the time
+        weights = 1.0 / np.power(np.arange(1, n_vals + 1), 1.1)
+        stream_1d = rng.choice(n_vals, size=200, p=weights / weights.sum())
+        # ensure all 10 values present
+        stream_1d = np.concatenate([stream_1d, np.arange(n_vals)]).astype(np.int32)
+        stream = np.tile(stream_1d, (R, 1))
+        state = dd.update(dd.init(jr.key(10), R, k), jnp.asarray(stream))
+        values, size = dd.result(state)
+        assert np.all(np.asarray(size) == k)
+        picked = np.asarray(values)[:, :k].ravel()
+        counts = np.bincount(picked, minlength=n_vals)
+        expected = R * k / n_vals
+        sigma = math.sqrt(R * 0.5 * 0.5)
+        assert np.all(np.abs(counts - expected) < 5 * sigma), counts
+
+
+class TestEngineIntegration:
+    def test_distinct_engine_lifecycle(self):
+        cfg = SamplerConfig(max_sample_size=8, num_reservoirs=4, tile_size=64, distinct=True)
+        e = ReservoirEngine(cfg, key=0)
+        stream = np.random.default_rng(11).integers(0, 100, (4, 500)).astype(np.int32)
+        e.sample_stream(stream)
+        res = e.result()
+        assert all(len(r) == 8 for r in res)
+        assert all(len(set(r.tolist())) == 8 for r in res)  # distinct
+        assert not e.is_open
+
+    def test_hash_fn_requires_distinct(self):
+        with pytest.raises(ValueError):
+            ReservoirEngine(
+                SamplerConfig(max_sample_size=4, num_reservoirs=2),
+                hash_fn=lambda x: (x, x),
+            )

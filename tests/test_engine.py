@@ -81,6 +81,6 @@ class TestResults:
         assert np.all(samples % 2 == 0)
         assert np.all(sizes == 8)
 
-    def test_distinct_config_rejected_for_now(self):
+    def test_weighted_config_rejected_for_now(self):
         with pytest.raises(NotImplementedError):
-            ReservoirEngine(cfg(distinct=True))
+            ReservoirEngine(cfg(weighted=True))
