@@ -18,8 +18,9 @@ own stream.  The engine owns:
   scatter can be dropped from the compiled program.
 
 ``SamplerConfig(distinct=True)`` selects the bottom-k kernel of
-:mod:`reservoir_tpu.ops.distinct` behind the same surface; weighted mode
-arrives with SURVEY §7.2 M6.
+:mod:`reservoir_tpu.ops.distinct` and ``weighted=True`` the A-ExpJ kernel of
+:mod:`reservoir_tpu.ops.weighted` (weights tile required per sample call),
+both behind the same lifecycle surface.
 """
 
 from __future__ import annotations
@@ -36,6 +37,7 @@ from .config import SamplerConfig, validate_max_sample_size
 from .errors import SamplerClosedError
 from .ops import algorithm_l as _algl
 from .ops import distinct as _distinct
+from .ops import weighted as _weighted
 
 __all__ = ["ReservoirEngine"]
 
@@ -64,8 +66,8 @@ class ReservoirEngine:
         reusable: bool = False,
     ) -> None:
         validate_max_sample_size(config.max_sample_size)
-        if config.weighted:
-            raise NotImplementedError("weighted mode arrives with M6")
+        if config.weighted and config.distinct:
+            raise ValueError("weighted and distinct modes are mutually exclusive")
         self._config = config
         self._map_fn = map_fn
         self._hash_fn = hash_fn
@@ -73,7 +75,12 @@ class ReservoirEngine:
         self._open = True
         if hash_fn is not None and not config.distinct:
             raise ValueError("hash_fn is only meaningful with distinct=True")
-        self._ops = _distinct if config.distinct else _algl
+        if config.distinct:
+            self._ops = _distinct
+        elif config.weighted:
+            self._ops = _weighted
+        else:
+            self._ops = _algl
         if key is None or isinstance(key, int):
             key = jr.key(0 if key is None else key)
         self._state = self._ops.init(
@@ -102,9 +109,13 @@ class ReservoirEngine:
         return True if self._reusable else self._open
 
     @property
-    def state(self) -> Union[_algl.ReservoirState, _distinct.DistinctState]:
-        """A snapshot of the state pytree (``ReservoirState`` in duplicates
-        mode, ``DistinctState`` in distinct mode).  Copied, because the engine's
+    def state(
+        self,
+    ) -> Union[
+        _algl.ReservoirState, _distinct.DistinctState, _weighted.WeightedState
+    ]:
+        """A snapshot of the state pytree (one of ``ReservoirState``/
+        ``DistinctState``/``WeightedState`` by mode).  Copied, because the engine's
         jitted updates donate the previous state's buffers (the streaming
         fast path) — handing out the live buffers would let a later
         ``sample()`` delete them out from under the caller."""
@@ -136,9 +147,12 @@ class ReservoirEngine:
             self._jit_cache[cache_key] = fn
         return fn
 
-    def sample(self, tile: Any, valid: Optional[Any] = None) -> None:
+    def sample(
+        self, tile: Any, valid: Optional[Any] = None, weights: Optional[Any] = None
+    ) -> None:
         """Consume one ``[R, B]`` tile (the engine's per-element hot path —
-        the batched analog of ``Sampler.scala:248-259``)."""
+        the batched analog of ``Sampler.scala:248-259``).  Weighted engines
+        additionally require a strictly positive ``[R, B]`` weight tile."""
         self._check_open()
         tile = jnp.asarray(tile)
         if tile.ndim != 2 or tile.shape[0] != self._config.num_reservoirs:
@@ -146,6 +160,25 @@ class ReservoirEngine:
                 f"tile must be [num_reservoirs={self._config.num_reservoirs}, B], "
                 f"got {tile.shape}"
             )
+        if self._config.weighted:
+            if weights is None:
+                raise ValueError("weighted engine requires a weights tile")
+            # Positivity is validated on host inputs only — device-resident
+            # weight tiles are accepted as-is so the hot path never forces a
+            # device->host sync (nonpositive weights there are a contract
+            # violation with undefined sampling bias, as documented).
+            if isinstance(weights, (np.ndarray, list, tuple)):
+                weights = np.asarray(weights, np.float32)
+                if not np.all(weights > 0):
+                    raise ValueError("weights must be strictly positive")
+            weights = jnp.asarray(weights, jnp.float32)
+            if tuple(weights.shape) != tuple(tile.shape):
+                raise ValueError(
+                    f"weights must match tile shape {tuple(tile.shape)}, "
+                    f"got {tuple(weights.shape)}"
+                )
+        elif weights is not None:
+            raise ValueError("weights are only meaningful with weighted=True")
         width = tile.shape[1]
         # distinct mode has one code path (update_steady is update); collapse
         # the cache key so crossing the fill boundary never recompiles
@@ -154,8 +187,9 @@ class ReservoirEngine:
             and self._min_count >= self._config.max_sample_size
         )
         fn = self._update_fn(width, steady)
+        args = (tile, weights) if self._config.weighted else (tile,)
         if valid is None:
-            self._state = fn(self._state, tile)
+            self._state = fn(self._state, *args)
             self._min_count += width
         else:
             valid_np = np.asarray(valid, np.int32)
@@ -168,36 +202,64 @@ class ReservoirEngine:
                     f"valid entries must be in [0, {width}], got "
                     f"[{valid_np.min()}, {valid_np.max()}]"
                 )
-            self._state = fn(self._state, tile, jnp.asarray(valid_np))
+            self._state = fn(self._state, *args, jnp.asarray(valid_np))
             self._min_count += int(valid_np.min())
 
     def sample_all(self, tiles: Any) -> None:
-        """Consume an iterable of tiles (bulk path, ``Sampler.scala:341``)."""
-        self._check_open()
-        for tile in tiles:
-            if isinstance(tile, tuple):
-                self.sample(tile[0], tile[1])
-            else:
-                self.sample(tile)
+        """Consume an iterable of tiles (bulk path, ``Sampler.scala:341``).
 
-    def sample_stream(self, stream: Any, tile_width: Optional[int] = None) -> None:
+        Unweighted engines take ``tile`` or ``(tile, valid)`` items; weighted
+        engines take ``(tile, weights)`` or ``(tile, weights, valid)``.
+        """
+        self._check_open()
+        for item in tiles:
+            if not isinstance(item, tuple):
+                self.sample(item)
+            elif self._config.weighted:
+                tile, weights = item[0], item[1]
+                valid = item[2] if len(item) > 2 else None
+                self.sample(tile, valid=valid, weights=weights)
+            else:
+                self.sample(item[0], valid=item[1] if len(item) > 1 else None)
+
+    def sample_stream(
+        self,
+        stream: Any,
+        tile_width: Optional[int] = None,
+        weights: Optional[Any] = None,
+    ) -> None:
         """Feed one ``[R, N]`` array, auto-tiled to ``config.tile_size``
-        columns with a masked ragged tail — never re-jitting per remainder."""
+        columns with a masked ragged tail — never re-jitting per remainder.
+        Weighted engines pass a parallel ``[R, N]`` ``weights`` array."""
         self._check_open()
         stream = np.asarray(stream)
         R, N = stream.shape
+        if self._config.weighted:
+            if weights is None:
+                raise ValueError("weighted engine requires a weights array")
+            weights = np.asarray(weights, np.float32)
+            if weights.shape != stream.shape:
+                raise ValueError(
+                    f"weights must match stream shape {stream.shape}, "
+                    f"got {weights.shape}"
+                )
         B = tile_width or self._config.tile_size
         for start in range(0, N, B):
             chunk = stream[:, start : start + B]
+            wchunk = weights[:, start : start + B] if weights is not None else None
             w = chunk.shape[1]
             if w < B:
                 pad = np.zeros((R, B - w), chunk.dtype)
-                self.sample(
-                    np.concatenate([chunk, pad], axis=1),
-                    np.full((R,), w, np.int32),
-                )
+                chunk = np.concatenate([chunk, pad], axis=1)
+                if wchunk is not None:
+                    # padding weight 1.0 keeps the positivity contract; the
+                    # valid mask excludes the padding from sampling anyway
+                    wchunk = np.concatenate(
+                        [wchunk, np.ones((R, B - w), np.float32)], axis=1
+                    )
+                self.sample(chunk, np.full((R,), w, np.int32), weights=wchunk)
             else:
-                self.sample(chunk)
+                self.sample(chunk, weights=wchunk)
 
     # --------------------------------------------------------------- results
 

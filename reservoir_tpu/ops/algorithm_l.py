@@ -38,7 +38,7 @@ import numpy as np
 
 from .rng import accept_draws
 
-__all__ = ["ReservoirState", "init", "update", "update_steady", "result"]
+__all__ = ["ReservoirState", "init", "update", "update_steady", "result", "merge"]
 
 
 class ReservoirState(NamedTuple):
@@ -254,6 +254,102 @@ def update_steady(
     the engine does this automatically).  Skipping the masked fill scatter
     saves a [B]-wide scatter per reservoir per tile."""
     return _update(state, batch, valid, map_fn, fill=False)
+
+
+def merge_samples(
+    samples_a: jax.Array,
+    count_a: jax.Array,
+    samples_b: jax.Array,
+    count_b: jax.Array,
+    key: jax.Array,
+) -> Tuple[jax.Array, jax.Array]:
+    """Exact result-level merge of two reservoir sets over disjoint streams.
+
+    Reservoir r of the output is a uniform ``min(k, nA+nB)``-subset of the
+    union of the two underlying streams: draw ``j ~ Hypergeometric(nA+nB,
+    nA, k)`` by a k-step without-replacement scan, then take uniform random
+    j / (k-j) subsets of the two reservoirs (valid because each input is
+    itself a uniform subset of its stream).  This is the distributed
+    one-logical-stream mode (SURVEY §5 "long-context" row): shards sample
+    independently, merges ride collectives; pairs compose into tree folds.
+
+    Args are ``(samples [R, k], count [R])`` pairs as produced by sampling —
+    entries past ``min(count, k)`` are ignored.  Returns the merged pair;
+    merged size is ``min(count_a + count_b, k)``.  The merge is *terminal* —
+    it yields a sample, not a resumable Algorithm-L state (``W``/``nxt`` of
+    a merged history are not reconstructible); keep per-shard states live to
+    continue streaming.
+
+    Counts enter the pick probabilities as f32: exact below 2^24 elements
+    per shard pair, O(2^-24)-biased beyond.
+    """
+    k = samples_a.shape[1]
+
+    def one(s_a, c_a, s_b, c_b, key_r):
+        sz_a = jnp.minimum(c_a, k)
+        sz_b = jnp.minimum(c_b, k)
+        total = c_a + c_b
+        m = jnp.minimum(total, k).astype(jnp.int32)
+
+        def step(carry, t):
+            rem_a, rem_b, j_a = carry
+            u = _uniform01(key_r, t)
+            denom = (rem_a + rem_b).astype(jnp.float32)
+            pick_a = (u * denom < rem_a.astype(jnp.float32)) & (rem_a > 0)
+            pick_a = pick_a | (rem_b <= 0)
+            active = t < m
+            take_a = active & pick_a
+            take_b = active & ~pick_a
+            return (
+                rem_a - take_a.astype(rem_a.dtype),
+                rem_b - take_b.astype(rem_b.dtype),
+                j_a + take_a.astype(jnp.int32),
+            ), None
+
+        (rem_a, rem_b, j_a), _ = jax.lax.scan(
+            step, (c_a, c_b, jnp.asarray(0, jnp.int32)), jnp.arange(k)
+        )
+        # uniform j_a-subset of A and (m - j_a)-subset of B via masked
+        # argsort; draw indices k and k+1 are disjoint from the scan's t < k
+        perm_a = _masked_perm(jr.fold_in(key_r, k), k, sz_a)
+        perm_b = _masked_perm(jr.fold_in(key_r, k + 1), k, sz_b)
+        pos = jnp.arange(k)
+        from_a = pos < j_a
+        idx = jnp.where(from_a, perm_a[pos], perm_b[jnp.maximum(pos - j_a, 0)])
+        merged = jnp.where(from_a, s_a[idx], s_b[idx])
+        merged = jnp.where(pos < m, merged, jnp.zeros((), s_a.dtype))
+        return merged, total
+
+    samples, count = jax.vmap(one)(
+        samples_a, count_a, samples_b, count_b,
+        jr.split(key, samples_a.shape[0]),
+    )
+    return samples, count
+
+
+def merge(
+    state_a: ReservoirState, state_b: ReservoirState, key: jax.Array
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """State-level convenience wrapper over :func:`merge_samples`; returns
+    ``(samples [R, k], size [R], count [R])``."""
+    samples, count = merge_samples(
+        state_a.samples, state_a.count, state_b.samples, state_b.count, key
+    )
+    size = jnp.minimum(count, state_a.k).astype(count.dtype)
+    return samples, size, count
+
+
+def _uniform01(key: jax.Array, idx) -> jax.Array:
+    bits = jr.bits(jr.fold_in(key, idx), (), jnp.uint32)
+    return ((bits >> 8).astype(jnp.float32) + 0.5) * float(2.0**-24)
+
+
+def _masked_perm(key: jax.Array, k: int, size) -> jax.Array:
+    """A random permutation of ``[0, size)`` padded into k slots: draw k
+    uniforms, push invalid slots to +inf, argsort."""
+    u = jr.uniform(key, (k,))
+    u = jnp.where(jnp.arange(k) < size, u, jnp.inf)
+    return jnp.argsort(u).astype(jnp.int32)
 
 
 def result(state: ReservoirState) -> Tuple[jax.Array, jax.Array]:

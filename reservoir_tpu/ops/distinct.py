@@ -42,7 +42,7 @@ import numpy as np
 
 from .hashing import default_hash64, scramble64
 
-__all__ = ["DistinctState", "init", "update", "update_steady", "result"]
+__all__ = ["DistinctState", "init", "update", "update_steady", "result", "merge"]
 
 _U32_MAX = jnp.uint32(0xFFFFFFFF)
 
@@ -202,6 +202,62 @@ def update(
 
 #: Distinct mode has no fill/steady split — the merge is one code path.
 update_steady = update
+
+
+def merge(state_a: DistinctState, state_b: DistinctState) -> DistinctState:
+    """Merge two distinct-reservoir sets over shards of the same logical
+    streams: union of entries, dedup, keep the bottom-k hashes.
+
+    Exact by the mergeable-summary property of bottom-k sketches.  Both
+    states MUST share salts (same ``init`` key) — hashes are only comparable
+    under one salt; shards of one logical stream are created that way.
+    ``count`` adds; tile-split invariance extends across shards.
+    """
+    k = state_a.values.shape[1]
+
+    def one(va, hia, loa, sza, ca, vb, hib, lob, szb, cb, salts):
+        pad_a = (jnp.arange(k) >= sza).astype(jnp.uint32)
+        pad_b = (jnp.arange(k) >= szb).astype(jnp.uint32)
+        m_values = jnp.concatenate([va, vb])
+        m_hi = jnp.concatenate([hia, hib])
+        m_lo = jnp.concatenate([loa, lob])
+        m_pad = jnp.concatenate([pad_a, pad_b])
+        m_vbits = m_values.view(jnp.uint32)
+        m_pad, m_hi, m_lo, m_vbits, m_values = jax.lax.sort(
+            (m_pad, m_hi, m_lo, m_vbits, m_values), num_keys=4
+        )
+        same = (
+            (m_pad == jnp.roll(m_pad, 1))
+            & (m_hi == jnp.roll(m_hi, 1))
+            & (m_lo == jnp.roll(m_lo, 1))
+            & (m_vbits == jnp.roll(m_vbits, 1))
+        )
+        same = same.at[0].set(False)
+        drop = same | (m_pad == 1)
+        m_hi = jnp.where(drop, _U32_MAX, m_hi)
+        m_lo = jnp.where(drop, _U32_MAX, m_lo)
+        m_values = jnp.where(drop, jnp.zeros((), m_values.dtype), m_values)
+        m_pad2 = drop.astype(jnp.uint32)
+        m_pad2, m_hi, m_lo, m_values = jax.lax.sort(
+            (m_pad2, m_hi, m_lo, m_values), num_keys=3
+        )
+        n_unique = jnp.sum(1 - m_pad2).astype(jnp.int32)
+        return (
+            m_values[:k],
+            m_hi[:k],
+            m_lo[:k],
+            jnp.minimum(n_unique, k),
+            ca + cb,
+        )
+
+    values, hi, lo, size, count = jax.vmap(one)(
+        state_a.values, state_a.hash_hi, state_a.hash_lo, state_a.size,
+        state_a.count,
+        state_b.values, state_b.hash_hi, state_b.hash_lo, state_b.size,
+        state_b.count,
+        state_a.salts,
+    )
+    return DistinctState(values, hi, lo, size, count, state_a.salts)
 
 
 def result(state: DistinctState) -> Tuple[jax.Array, jax.Array]:

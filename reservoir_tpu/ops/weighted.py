@@ -1,0 +1,249 @@
+"""Weighted reservoir sampling on device: batched A-ExpJ (SURVEY §7.2 M6).
+
+Capability beyond the reference (BASELINE config 4): R lockstep weighted
+reservoirs, each holding the k items with the largest Efraimidis-Spirakis
+keys ``u^(1/w)`` seen so far.  The exponential-jumps structure maps onto
+tiles exactly like Algorithm L's skip counts (:mod:`.algorithm_l`):
+
+- state carries ``xw`` — the remaining *weight* to skip before the next
+  acceptance (the weighted analog of ``nxt - count``);
+- per tile, a masked cumulative-sum of the weights turns "skip until
+  cumulative weight crosses xw" into one ``searchsorted`` per acceptance;
+  a tile with no acceptance costs one cumsum + one compare per reservoir,
+  and skipped items draw no RNG;
+- the acceptance ``while_loop`` gives the crossing item a key conditioned
+  to beat the current threshold (``r2 ~ U(T^w, 1)``, ``lkey = log(r2)/w``),
+  replaces the argmin slot, and redraws ``xw`` against the new threshold.
+
+RNG is counter-keyed on the absolute item index (three channels per index:
+fill-key u, conditional-key u, jump u; the fill-completion jump draw is keyed
+on index k), so tile splits cannot change which draws an item consumes.
+Tile-split invariance is bit-exact when the weight partial sums are exact in
+float32 (e.g. integer weights summing below 2^24) and within float rounding
+otherwise — the jump accumulator ``xw`` is carried across tiles as a float.
+
+Keys and ``xw`` live in log-space (SURVEY §7.3).  Weights must be strictly
+positive (the engine validates); zero-weight semantics ("never sampled")
+are available in the CPU oracle.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import jax.random as jr
+import numpy as np
+
+__all__ = ["WeightedState", "init", "update", "update_steady", "result", "merge"]
+
+_INV_2_24 = float(2.0**-24)
+_NEG_INF = float("-inf")
+
+
+class WeightedState(NamedTuple):
+    """R lockstep weighted reservoirs (A-ExpJ)."""
+
+    samples: jax.Array  # [R, k] sample dtype
+    lkeys: jax.Array  # [R, k] f32 — log of ES keys; -inf = empty slot
+    count: jax.Array  # [R] count dtype
+    xw: jax.Array  # [R] f32 — remaining weight to skip; +inf while filling
+    key: jax.Array  # [R] PRNG keys
+
+
+def _uniforms(key: jax.Array, idx) -> jax.Array:
+    """Three (0,1] f32 uniforms for absolute index ``idx``:
+    [0] fill key, [1] conditional key (r2), [2] jump draw."""
+    bits = jr.bits(jr.fold_in(key, idx), (3,), jnp.uint32)
+    return ((bits >> 8).astype(jnp.float32) + 1.0) * _INV_2_24
+
+
+def init(
+    key: jax.Array,
+    num_reservoirs: int,
+    k: int,
+    sample_dtype: Any = jnp.int32,
+    count_dtype: Any = jnp.int32,
+) -> WeightedState:
+    keys = jr.split(key, num_reservoirs)
+    return WeightedState(
+        samples=jnp.zeros((num_reservoirs, k), sample_dtype),
+        lkeys=jnp.full((num_reservoirs, k), _NEG_INF, jnp.float32),
+        count=jnp.zeros((num_reservoirs,), jnp.dtype(count_dtype)),
+        xw=jnp.full((num_reservoirs,), jnp.inf, jnp.float32),
+        key=keys,
+    )
+
+
+def _draw_xw(u3: jax.Array, lt: jax.Array) -> jax.Array:
+    """``Xw = log(r)/log(T)`` in log-space, guarding the degenerate
+    threshold-key-of-1 case (nothing can beat it -> skip forever)."""
+    return jnp.where(lt >= 0.0, jnp.inf, jnp.log(u3) / lt)
+
+
+def _update_one(
+    samples,
+    lkeys,
+    count,
+    xw,
+    key,
+    elems,
+    weights,
+    valid,
+    k: int,
+    map_fn: Optional[Callable],
+    fill: bool,
+):
+    bsz = elems.shape[0]
+    count_dtype = count.dtype
+    in_tile = jnp.arange(bsz) < valid
+    idx_abs = count + jnp.arange(1, bsz + 1, dtype=count_dtype)
+    w_masked = jnp.where(in_tile, weights.astype(jnp.float32), 0.0)
+    cw = jnp.cumsum(w_masked)
+    total_w = jnp.where(valid > 0, cw[bsz - 1], 0.0)
+
+    if fill:
+        # fill phase: items with absolute index <= k take slots directly,
+        # keyed lkey = log(u)/w with u from their index's fill channel.
+        fill_mask = (idx_abs <= k) & in_tile
+        u_fill = jax.vmap(lambda i: _uniforms(key, i)[0])(idx_abs)
+        lk_fill = jnp.log(u_fill) / weights.astype(jnp.float32)
+        dest = jnp.where(fill_mask, (idx_abs - 1).astype(jnp.int32), k)
+        values = map_fn(elems) if map_fn is not None else elems
+        samples = samples.at[dest].set(
+            jnp.asarray(values, samples.dtype), mode="drop"
+        )
+        lkeys = lkeys.at[dest].set(lk_fill, mode="drop")
+        # fill completing inside this tile draws the first jump, keyed on
+        # index k, against the threshold of the just-filled reservoir
+        completes = (count < k) & (count + valid.astype(count_dtype) >= k)
+        u3_init = _uniforms(key, jnp.asarray(k, count_dtype))[2]
+        xw = jnp.where(completes, _draw_xw(u3_init, jnp.min(lkeys)), xw)
+
+    # acceptance scanning starts after any fill positions in this tile
+    start = jnp.clip(k - count, 0, bsz).astype(jnp.int32)
+    base0 = jnp.where(start > 0, cw[jnp.maximum(start - 1, 0)], 0.0)
+
+    def next_j(base, xw_c, cur):
+        j = jnp.searchsorted(cw, base + xw_c, side="left").astype(jnp.int32)
+        return jnp.maximum(j, cur)
+
+    def cond(carry):
+        _, _, xw_c, base, cur = carry
+        return next_j(base, xw_c, cur) < valid
+
+    def body(carry):
+        samples_c, lkeys_c, xw_c, base, cur = carry
+        j = next_j(base, xw_c, cur)
+        w_c = w_masked[j]
+        idx = count + 1 + j.astype(count_dtype)
+        u = _uniforms(key, idx)
+        lt = jnp.min(lkeys_c)
+        t = jnp.exp(w_c * lt)
+        r2 = t + u[1] * (1.0 - t)
+        lkey_new = jnp.log(r2) / w_c
+        slot = jnp.argmin(lkeys_c).astype(jnp.int32)
+        value = map_fn(elems[j]) if map_fn is not None else elems[j]
+        samples_c = samples_c.at[slot].set(jnp.asarray(value, samples_c.dtype))
+        lkeys_c = lkeys_c.at[slot].set(lkey_new)
+        xw_n = _draw_xw(u[2], jnp.min(lkeys_c))
+        return samples_c, lkeys_c, xw_n, cw[j], j + 1
+
+    samples, lkeys, xw, base, _cur = jax.lax.while_loop(
+        cond, body, (samples, lkeys, xw, base0, start)
+    )
+    # carry the unconsumed jump across the tile boundary
+    xw = xw - (total_w - base)
+    count = count + valid.astype(count_dtype)
+    return samples, lkeys, count, xw
+
+
+def _update(state, elems, weights, valid, map_fn, fill):
+    k = state.samples.shape[1]
+    if valid is None:
+        valid_arg = jnp.asarray(elems.shape[1], jnp.int32)
+        in_axes = (0, 0, 0, 0, 0, 0, 0, None)
+    else:
+        valid_arg = valid
+        in_axes = (0, 0, 0, 0, 0, 0, 0, 0)
+    samples, lkeys, count, xw = jax.vmap(
+        functools.partial(_update_one, k=k, map_fn=map_fn, fill=fill),
+        in_axes=in_axes,
+    )(state.samples, state.lkeys, state.count, state.xw, state.key, elems, weights, valid_arg)
+    return WeightedState(samples, lkeys, count, xw, state.key)
+
+
+def update(
+    state: WeightedState,
+    elems: jax.Array,
+    weights: jax.Array,
+    valid: Optional[jax.Array] = None,
+    map_fn: Optional[Callable] = None,
+) -> WeightedState:
+    """Consume one ``([R, B], [R, B])`` (elements, weights) tile pair."""
+    return _update(state, elems, weights, valid, map_fn, fill=True)
+
+
+def update_steady(
+    state: WeightedState,
+    elems: jax.Array,
+    weights: jax.Array,
+    valid: Optional[jax.Array] = None,
+    map_fn: Optional[Callable] = None,
+) -> WeightedState:
+    """:func:`update` without the fill scatter (all reservoirs full)."""
+    return _update(state, elems, weights, valid, map_fn, fill=False)
+
+
+def merge_parts(
+    samples_a: jax.Array,
+    lkeys_a: jax.Array,
+    count_a: jax.Array,
+    samples_b: jax.Array,
+    lkeys_b: jax.Array,
+    count_b: jax.Array,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Top-k-of-union merge on raw ``(samples, lkeys, count)`` triples — the
+    composable core shared by :func:`merge` and the stream-axis collective
+    merger (:mod:`reservoir_tpu.parallel.merge`).
+
+    Exact: ES keys are i.i.d. draws per item, so the global top-k of the
+    union is the correct merged sample regardless of how the stream was
+    sharded.
+    """
+    k = samples_a.shape[1]
+
+    def one(sa, lka, ca, sb, lkb, cb):
+        m_s = jnp.concatenate([sa, sb])
+        m_lk = jnp.concatenate([lka, lkb])
+        # sort by descending lkey: top-k first
+        order = jnp.argsort(-m_lk)
+        return m_s[order[:k]], m_lk[order[:k]], ca + cb
+
+    return jax.vmap(one)(
+        samples_a, lkeys_a, count_a, samples_b, lkeys_b, count_b
+    )
+
+
+def merge(state_a: WeightedState, state_b: WeightedState) -> WeightedState:
+    """State-level wrapper over :func:`merge_parts`.
+
+    The merged ``xw`` is not meaningful (we keep A's to allow result-only
+    use) — continue streaming on the per-shard states, as with Algorithm-L
+    merges.
+    """
+    samples, lkeys, count = merge_parts(
+        state_a.samples, state_a.lkeys, state_a.count,
+        state_b.samples, state_b.lkeys, state_b.count,
+    )
+    return WeightedState(samples, lkeys, count, state_a.xw, state_a.key)
+
+
+def result(state: WeightedState) -> Tuple[jax.Array, jax.Array]:
+    """``(samples [R, k], size [R])`` — size is min(count, k)."""
+    k = state.samples.shape[1]
+    size = jnp.minimum(state.count, k).astype(state.count.dtype)
+    mask = jnp.arange(k)[None, :] < size[:, None]
+    return jnp.where(mask, state.samples, jnp.zeros_like(state.samples)), size

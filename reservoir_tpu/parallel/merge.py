@@ -1,0 +1,133 @@
+"""Stream-axis parallelism: one logical stream sharded across chips.
+
+The reference handles its "long" dimension — the unbounded stream — in O(k)
+memory on one thread (``Sampler.scala:11-12``); the TPU framework adds the
+axis the reference cannot: split a logical stream across D devices, sample
+each shard independently (zero communication in the hot loop), and combine
+with an exact merge that rides ICI collectives (SURVEY §5 long-context row).
+
+Mechanism per mode:
+
+- uniform (Algorithm L): hypergeometric pairwise merge
+  (:func:`reservoir_tpu.ops.algorithm_l.merge_samples`), folded across the
+  device axis after an ``all_gather``;
+- distinct: bottom-k union (shared salts across shards);
+- weighted: top-k union of ES keys.
+
+The collective is one ``all_gather`` of O(R·k) summary state per result —
+amortized over arbitrarily long shard streams; the hot sampling loop stays
+collective-free.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import jax.random as jr
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..ops import algorithm_l as _algl
+from ..ops import distinct as _distinct
+from ..ops import weighted as _weighted
+
+__all__ = [
+    "uniform_stream_merger",
+    "distinct_stream_merger",
+    "weighted_stream_merger",
+]
+
+
+def uniform_stream_merger(mesh: Mesh, axis: str = "stream"):
+    """Jitted ``fn(samples [D, R, k], count [D, R], key) -> (samples [R, k],
+    count [R])`` merging per-device Algorithm-L results into one logical
+    sample, replicated on every device.
+
+    Inputs are the stacked per-shard results, sharded ``P(axis)`` on the
+    leading device axis; the fold happens after an ``all_gather`` over
+    ``axis`` and is identical on every device (same key), so the output is
+    replicated by construction.
+    """
+    D = mesh.shape[axis]
+
+    def local(samples, count, key):
+        # inside shard_map: samples [1, R, k], count [1, R]; key replicated
+        g_s = jax.lax.all_gather(samples[0], axis)  # [D, R, k]
+        g_c = jax.lax.all_gather(count[0], axis)  # [D, R]
+
+        def fold(carry, xs):
+            s, c = carry
+            s2, c2, step = xs
+            s, c = _algl.merge_samples(s, c, s2, c2, jr.fold_in(key, step))
+            return (s, c), None
+
+        (s, c), _ = jax.lax.scan(
+            fold,
+            (g_s[0], g_c[0]),
+            (g_s[1:], g_c[1:], jnp.arange(1, D)),
+        )
+        return s, c
+
+    return jax.jit(
+        jax.shard_map(
+            local,
+            mesh=mesh,
+            in_specs=(P(axis), P(axis), P()),
+            out_specs=(P(), P()),
+            check_vma=False,
+        )
+    )
+
+
+def _summary_merger(mesh: Mesh, axis: str, pairwise, n_leaves: int):
+    """Shared all_gather + fold skeleton for key/hash-based merges (no RNG)."""
+    D = mesh.shape[axis]
+
+    def local(*leaves):
+        gathered = [jax.lax.all_gather(leaf[0], axis) for leaf in leaves]
+
+        def fold(carry, xs):
+            return pairwise(carry, xs), None
+
+        carry0 = tuple(g[0] for g in gathered)
+        rest = tuple(g[1:] for g in gathered)
+        out, _ = jax.lax.scan(fold, carry0, rest)
+        return out
+
+    return jax.jit(
+        jax.shard_map(
+            local,
+            mesh=mesh,
+            in_specs=tuple(P(axis) for _ in range(n_leaves)),
+            out_specs=tuple(P() for _ in range(n_leaves)),
+            check_vma=False,
+        )
+    )
+
+
+def distinct_stream_merger(mesh: Mesh, axis: str = "stream"):
+    """Jitted merger for stacked per-device ``DistinctState`` leaves
+    ``(values, hash_hi, hash_lo, size, count)`` (salts shared across shards,
+    passed separately): returns the replicated merged leaves."""
+
+    def pairwise(a, b):
+        va, hia, loa, sza, ca, salts = a
+        vb, hib, lob, szb, cb, _ = b
+        sa = _distinct.DistinctState(va, hia, loa, sza, ca, salts)
+        sb = _distinct.DistinctState(vb, hib, lob, szb, cb, salts)
+        m = _distinct.merge(sa, sb)
+        return (m.values, m.hash_hi, m.hash_lo, m.size, m.count, salts)
+
+    return _summary_merger(mesh, axis, pairwise, n_leaves=6)
+
+
+def weighted_stream_merger(mesh: Mesh, axis: str = "stream"):
+    """Jitted merger for stacked per-device weighted results
+    ``(samples, lkeys, count)``: top-k-of-union, replicated."""
+
+    def pairwise(a, b):
+        return _weighted.merge_parts(*a, *b)
+
+    return _summary_merger(mesh, axis, pairwise, n_leaves=3)

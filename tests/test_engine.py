@@ -81,6 +81,7 @@ class TestResults:
         assert np.all(samples % 2 == 0)
         assert np.all(sizes == 8)
 
-    def test_weighted_config_rejected_for_now(self):
-        with pytest.raises(NotImplementedError):
-            ReservoirEngine(cfg(weighted=True))
+    def test_all_modes_construct(self):
+        assert ReservoirEngine(cfg()).is_open
+        assert ReservoirEngine(cfg(distinct=True)).is_open
+        assert ReservoirEngine(cfg(weighted=True)).is_open

@@ -1,0 +1,201 @@
+"""Reservoir-merge tests: pairwise exactness + stream-axis collectives.
+
+The merge is the framework's long-context/sequence-parallel analog
+(SURVEY §5): one logical stream sharded across devices, sampled
+independently, combined exactly.  Statistical gates verify the merged
+sample is uniform over the *union* stream (the property naive
+concatenation would violate)."""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+import jax.random as jr
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from reservoir_tpu.ops import algorithm_l as al
+from reservoir_tpu.ops import distinct as dd
+from reservoir_tpu.ops import weighted as wd
+from reservoir_tpu.parallel import make_mesh
+from reservoir_tpu.parallel.merge import (
+    distinct_stream_merger,
+    uniform_stream_merger,
+    weighted_stream_merger,
+)
+
+needs_mesh = pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8 devices")
+
+
+class TestPairwiseUniformMerge:
+    def test_merged_count_and_membership(self):
+        R, k = 8, 4
+        a = al.update(al.init(jr.key(0), R, k), jnp.arange(R * 100, dtype=jnp.int32).reshape(R, 100))
+        b = al.update(
+            al.init(jr.key(1), R, k),
+            (1000 + jnp.arange(R * 50, dtype=jnp.int32)).reshape(R, 50),
+        )
+        samples, size, count = al.merge(a, b, jr.key(2))
+        assert np.all(np.asarray(count) == 150)
+        assert np.all(np.asarray(size) == k)
+        # every merged element must come from one of the two input reservoirs
+        for r in range(R):
+            pool = set(np.asarray(a.samples)[r]) | set(np.asarray(b.samples)[r])
+            assert set(np.asarray(samples)[r]) <= pool
+
+    def test_merge_with_underfull_inputs(self):
+        R, k = 4, 8
+        a = al.update(al.init(jr.key(3), R, k), jnp.arange(R * 3, dtype=jnp.int32).reshape(R, 3))
+        b = al.update(
+            al.init(jr.key(4), R, k),
+            (100 + jnp.arange(R * 2, dtype=jnp.int32)).reshape(R, 2),
+        )
+        samples, size, count = al.merge(a, b, jr.key(5))
+        assert np.all(np.asarray(count) == 5)
+        assert np.all(np.asarray(size) == 5)  # all elements survive: n < k
+        for r in range(R):
+            got = sorted(np.asarray(samples)[r][:5].tolist())
+            expect = sorted(
+                np.asarray(a.samples)[r][:3].tolist()
+                + np.asarray(b.samples)[r][:2].tolist()
+            )
+            assert got == expect
+
+    def test_merge_uniform_over_union_5_sigma(self):
+        # Streams of unequal length (n1=30, n2=10): every element of the
+        # union must land in the merged k=4 sample with probability
+        # k/(n1+n2) = 0.1 — the hypergeometric mixing is what guarantees
+        # this; a naive 50/50 merge would overweight the short stream.
+        R, k, n1, n2 = 40_000, 4, 30, 10
+        a = al.update(
+            al.init(jr.key(6), R, k), jnp.tile(jnp.arange(n1, dtype=jnp.int32), (R, 1))
+        )
+        b = al.update(
+            al.init(jr.key(7), R, k),
+            jnp.tile(jnp.arange(n1, n1 + n2, dtype=jnp.int32), (R, 1)),
+        )
+        samples, size, count = al.merge(a, b, jr.key(8))
+        assert np.all(np.asarray(size) == k)
+        counts = np.bincount(np.asarray(samples).ravel(), minlength=n1 + n2)
+        p = k / (n1 + n2)
+        sigma = math.sqrt(R * p * (1 - p))
+        assert np.all(np.abs(counts - R * p) < 5 * sigma), counts
+
+
+class TestPairwiseSummaryMerges:
+    def test_distinct_merge_equals_joint_run(self):
+        # bottom-k is a mergeable summary: merge(shard1, shard2) must be
+        # bit-identical to sampling the concatenated stream (shared salts).
+        R, k = 4, 6
+        s1 = np.random.default_rng(0).integers(0, 200, (R, 50)).astype(np.int32)
+        s2 = np.random.default_rng(1).integers(0, 200, (R, 70)).astype(np.int32)
+        base = dd.init(jr.key(9), R, k)
+        a = dd.update(base, jnp.asarray(s1))
+        b = dd.update(base, jnp.asarray(s2))
+        merged = dd.merge(a, b)
+        joint = dd.update(base, jnp.asarray(np.concatenate([s1, s2], axis=1)))
+        np.testing.assert_array_equal(np.asarray(merged.values), np.asarray(joint.values))
+        np.testing.assert_array_equal(np.asarray(merged.size), np.asarray(joint.size))
+        np.testing.assert_array_equal(np.asarray(merged.count), np.asarray(joint.count))
+
+    def test_weighted_merge_equals_joint_run(self):
+        # ES keys are per-item draws keyed on absolute index... shards use
+        # DIFFERENT absolute indices, so exact equality needs the union
+        # property instead: merged = top-k of the two key sets.
+        R, k = 4, 5
+        e1 = jnp.arange(R * 20, dtype=jnp.int32).reshape(R, 20)
+        e2 = (1000 + jnp.arange(R * 30, dtype=jnp.int32)).reshape(R, 30)
+        a = wd.update(wd.init(jr.key(10), R, k), e1, jnp.ones((R, 20), jnp.float32))
+        b = wd.update(wd.init(jr.key(11), R, k), e2, jnp.ones((R, 30), jnp.float32))
+        m = wd.merge(a, b)
+        assert np.all(np.asarray(m.count) == 50)
+        # top-k of union of lkeys
+        for r in range(2):
+            pool = np.concatenate([np.asarray(a.lkeys)[r], np.asarray(b.lkeys)[r]])
+            np.testing.assert_allclose(
+                np.sort(np.asarray(m.lkeys)[r])[::-1],
+                np.sort(pool)[::-1][:k],
+                rtol=1e-6,
+            )
+
+
+@needs_mesh
+class TestStreamMergers:
+    def _stacked_uniform(self, D, R, k, N):
+        states = []
+        for d in range(D):
+            st = al.init(jr.fold_in(jr.key(0), d), R, k)
+            stream = jnp.tile(
+                jnp.arange(d * N, (d + 1) * N, dtype=jnp.int32), (R, 1)
+            )
+            states.append(al.update(st, stream))
+        return (
+            jnp.stack([s.samples for s in states]),
+            jnp.stack([s.count for s in states]),
+        )
+
+    def test_uniform_stream_merger(self):
+        D, R, k, N = 8, 16, 8, 200
+        mesh = make_mesh(8, axis="stream")
+        samples, count = self._stacked_uniform(D, R, k, N)
+        sh = NamedSharding(mesh, P("stream"))
+        ms, mc = uniform_stream_merger(mesh)(
+            jax.device_put(samples, sh), jax.device_put(count, sh), jr.key(99)
+        )
+        assert np.all(np.asarray(mc) == D * N)
+        flat = np.asarray(ms)
+        assert flat.shape == (R, k)
+        assert flat.min() >= 0 and flat.max() < D * N
+        # all shards represented across the pooled merged samples
+        hist = np.bincount(flat.ravel() // N, minlength=D)
+        assert np.all(hist > 0)
+
+    def test_weighted_stream_merger(self):
+        D, R, k, N = 8, 8, 4, 100
+        mesh = make_mesh(8, axis="stream")
+        st_list = []
+        for d in range(D):
+            st = wd.init(jr.fold_in(jr.key(1), d), R, k)
+            elems = jnp.tile(jnp.arange(d * N, (d + 1) * N, dtype=jnp.int32), (R, 1))
+            st_list.append(wd.update(st, elems, jnp.ones((R, N), jnp.float32)))
+        sh = NamedSharding(mesh, P("stream"))
+        stacked = [
+            jax.device_put(jnp.stack([getattr(s, f) for s in st_list]), sh)
+            for f in ("samples", "lkeys", "count")
+        ]
+        ms, mlk, mc = weighted_stream_merger(mesh)(*stacked)
+        assert np.all(np.asarray(mc) == D * N)
+        # merged keys are the global top-k
+        for r in range(2):
+            pool = np.concatenate([np.asarray(s.lkeys)[r] for s in st_list])
+            np.testing.assert_allclose(
+                np.sort(np.asarray(mlk)[r])[::-1], np.sort(pool)[::-1][:k], rtol=1e-6
+            )
+
+    def test_distinct_stream_merger(self):
+        D, R, k = 8, 4, 6
+        mesh = make_mesh(8, axis="stream")
+        base = dd.init(jr.key(2), R, k)  # shared salts across shards
+        rng = np.random.default_rng(3)
+        st_list, all_streams = [], []
+        for d in range(D):
+            s = rng.integers(0, 100, (R, 40)).astype(np.int32)
+            all_streams.append(s)
+            st_list.append(dd.update(base, jnp.asarray(s)))
+        sh = NamedSharding(mesh, P("stream"))
+        leaves = [
+            jax.device_put(jnp.stack([getattr(s, f) for s in st_list]), sh)
+            for f in ("values", "hash_hi", "hash_lo", "size", "count")
+        ]
+        salts = jax.device_put(
+            jnp.stack([st.salts for st in st_list]), sh
+        )
+        mv, mhi, mlo, msz, mc, _ = distinct_stream_merger(mesh)(*leaves, salts)
+        joint = dd.update(base, jnp.asarray(np.concatenate(all_streams, axis=1)))
+        np.testing.assert_array_equal(np.asarray(mv), np.asarray(joint.values))
+        np.testing.assert_array_equal(np.asarray(msz), np.asarray(joint.size))
+        np.testing.assert_array_equal(np.asarray(mc), np.asarray(joint.count))

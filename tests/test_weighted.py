@@ -1,0 +1,229 @@
+"""M6 weighted-sampling tests: A-ES/A-ExpJ oracles + batched device kernel.
+
+No reference counterpart exists (the reference has no weighted mode —
+SURVEY §6); the ground truth is the naive A-ES construction itself:
+assign every item the key ``u^(1/w)``, keep the top k.  The chain under
+test: naive oracle == A-ExpJ oracle == device kernel, distributionally;
+plus exact tile-split invariance on the device under f32-exact weights.
+"""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+import jax.random as jr
+
+from reservoir_tpu import SamplerConfig
+from reservoir_tpu.engine import ReservoirEngine
+from reservoir_tpu.oracle.weighted import AExpJOracle, NaiveWeightedOracle
+from reservoir_tpu.ops import weighted as wd
+
+
+def inclusion_freq_oracle(cls, k, items, weights, trials, seed0):
+    n = len(items)
+    counts = np.zeros(n, dtype=np.int64)
+    for t in range(trials):
+        o = cls(k, np.random.default_rng(seed0 + t))
+        o.sample_all(zip(items, weights))
+        counts[o.result()] += 1
+    return counts / trials
+
+
+class TestOracles:
+    def test_k_of_equal_weights_is_uniform(self):
+        n, k, trials = 10, 5, 4000
+        freq = inclusion_freq_oracle(
+            NaiveWeightedOracle, k, list(range(n)), [1.0] * n, trials, 100
+        )
+        sigma = math.sqrt(0.25 / trials)
+        assert np.all(np.abs(freq - 0.5) < 5 * sigma)
+
+    def test_k1_proportional_to_weight(self):
+        # k=1: P(item) = w_i / sum(w) exactly, for both oracles.
+        n, trials = 5, 8000
+        weights = [1.0, 2.0, 3.0, 4.0, 10.0]
+        p = np.asarray(weights) / sum(weights)
+        for cls in (NaiveWeightedOracle, AExpJOracle):
+            freq = inclusion_freq_oracle(cls, 1, list(range(n)), weights, trials, 200)
+            sigma = np.sqrt(p * (1 - p) / trials)
+            assert np.all(np.abs(freq - p) < 5 * sigma), (cls, freq, p)
+
+    def test_aexpj_matches_naive_distribution(self):
+        # Same inclusion frequencies (within 5 sigma, two-sample) on a skewed
+        # weight profile — the jump algorithm is a pure optimization.
+        n, k, trials = 12, 4, 6000
+        weights = [1.0 / (i + 1) for i in range(n)]
+        fa = inclusion_freq_oracle(NaiveWeightedOracle, k, list(range(n)), weights, trials, 300)
+        fb = inclusion_freq_oracle(AExpJOracle, k, list(range(n)), weights, trials, 9300)
+        sigma2 = fa * (1 - fa) / trials + fb * (1 - fb) / trials
+        z = np.abs(fa - fb) / np.sqrt(np.maximum(sigma2, 1e-12))
+        assert np.all(z < 5), (fa, fb, z)
+
+    def test_zero_weight_never_sampled(self):
+        o = NaiveWeightedOracle(5, np.random.default_rng(0))
+        o.sample_all([(i, 0.0 if i % 2 else 1.0) for i in range(100)])
+        assert all(v % 2 == 0 for v in o.result())
+        o2 = AExpJOracle(3, np.random.default_rng(1))
+        o2.sample_all([(i, 1.0) for i in range(10)] + [(99, 0.0)] * 50)
+        assert 99 not in o2.result()
+        assert o2.count == 60
+
+    def test_negative_weight_rejected(self):
+        for cls in (NaiveWeightedOracle, AExpJOracle):
+            with pytest.raises(ValueError):
+                cls(3, np.random.default_rng(0)).sample(1, -1.0)
+
+    def test_aexpj_skips_rng_draws(self):
+        # The jump structure must not draw per skipped element: count RNG
+        # consumption via a wrapping generator.
+        class CountingRng:
+            def __init__(self):
+                self._g = np.random.default_rng(0)
+                self.calls = 0
+
+            def random(self):
+                self.calls += 1
+                return self._g.random()
+
+        rng = CountingRng()
+        o = AExpJOracle(8, rng)
+        n = 20_000
+        o.sample_all((i, 1.0) for i in range(n))
+        # expected accepts ~ k ln(n/k) ~ 63; draws ~ k + 2*accepts + jumps
+        assert rng.calls < 600, rng.calls
+
+
+class TestDeviceKernel:
+    def test_fill_arrival_order_under_k(self):
+        state = wd.init(jr.key(0), 2, 8)
+        elems = jnp.arange(10, dtype=jnp.int32).reshape(2, 5)
+        state = wd.update(state, elems, jnp.ones((2, 5), jnp.float32))
+        samples, size = wd.result(state)
+        assert np.all(np.asarray(size) == 5)
+        np.testing.assert_array_equal(np.asarray(samples)[:, :5], np.asarray(elems))
+
+    @pytest.mark.parametrize("tiles", [[1] * 30, [30], [7, 13, 10]])
+    def test_tile_split_invariance_integer_weights(self, tiles):
+        R, k, N = 4, 4, 30
+        rng = np.random.default_rng(5)
+        elems = rng.integers(0, 1 << 30, (R, N)).astype(np.int32)
+        weights = rng.integers(1, 8, (R, N)).astype(np.float32)  # f32-exact sums
+        ref = wd.update(wd.init(jr.key(6), R, k), jnp.asarray(elems), jnp.asarray(weights))
+        state = wd.init(jr.key(6), R, k)
+        start = 0
+        for b in tiles:
+            state = wd.update(
+                state,
+                jnp.asarray(elems[:, start : start + b]),
+                jnp.asarray(weights[:, start : start + b]),
+            )
+            start += b
+        np.testing.assert_array_equal(np.asarray(ref.samples), np.asarray(state.samples))
+        np.testing.assert_array_equal(np.asarray(ref.count), np.asarray(state.count))
+        np.testing.assert_allclose(np.asarray(ref.xw), np.asarray(state.xw), rtol=1e-5)
+
+    def test_equal_weights_uniform_5_sigma(self):
+        R, n, k = 20_000, 10, 5
+        elems = jnp.tile(jnp.arange(n, dtype=jnp.int32), (R, 1))
+        state = wd.update(wd.init(jr.key(7), R, k), elems, jnp.ones((R, n), jnp.float32))
+        samples, size = wd.result(state)
+        assert np.all(np.asarray(size) == k)
+        counts = np.bincount(np.asarray(samples).ravel(), minlength=n)
+        sigma = math.sqrt(R * 0.25)
+        assert np.all(np.abs(counts - R * k / n) < 5 * sigma), counts
+
+    def test_k1_proportional_to_weight_device(self):
+        R, n = 30_000, 5
+        weights_row = np.array([1.0, 2.0, 3.0, 4.0, 10.0], np.float32)
+        p = weights_row / weights_row.sum()
+        elems = jnp.tile(jnp.arange(n, dtype=jnp.int32), (R, 1))
+        weights = jnp.tile(jnp.asarray(weights_row), (R, 1))
+        state = wd.update(wd.init(jr.key(8), R, 1), elems, weights)
+        samples, _ = wd.result(state)
+        freq = np.bincount(np.asarray(samples)[:, 0], minlength=n) / R
+        sigma = np.sqrt(p * (1 - p) / R)
+        assert np.all(np.abs(freq - p) < 5 * sigma), (freq, p)
+
+    def test_device_matches_naive_oracle_distribution(self):
+        # Device inclusion frequencies vs naive-oracle frequencies on a
+        # Zipf-ish profile (BASELINE config 4 shape), 5 sigma two-sample.
+        R, n, k = 20_000, 12, 4
+        weights_row = np.asarray([1.0 / (i + 1) for i in range(n)], np.float32)
+        elems = jnp.tile(jnp.arange(n, dtype=jnp.int32), (R, 1))
+        weights = jnp.tile(jnp.asarray(weights_row), (R, 1))
+        state = wd.update(wd.init(jr.key(9), R, k), elems, weights)
+        samples, size = wd.result(state)
+        assert np.all(np.asarray(size) == k)
+        f_dev = np.bincount(np.asarray(samples).ravel(), minlength=n) / R
+        trials = 4000
+        f_cpu = inclusion_freq_oracle(
+            NaiveWeightedOracle, k, list(range(n)), list(weights_row), trials, 500
+        )
+        sigma2 = f_dev * (1 - f_dev) / R + f_cpu * (1 - f_cpu) / trials
+        z = np.abs(f_dev - f_cpu) / np.sqrt(np.maximum(sigma2, 1e-12))
+        assert np.all(z < 5), (f_dev, f_cpu, z)
+
+
+class TestEngineIntegration:
+    def test_weighted_engine_lifecycle(self):
+        cfg = SamplerConfig(max_sample_size=8, num_reservoirs=4, weighted=True)
+        e = ReservoirEngine(cfg, key=0)
+        rng = np.random.default_rng(0)
+        elems = rng.integers(0, 1 << 20, (4, 256)).astype(np.int32)
+        w = rng.uniform(0.1, 5.0, (4, 256)).astype(np.float32)
+        e.sample(elems, weights=w)
+        res = e.result()
+        assert all(len(r) == 8 for r in res)
+        assert not e.is_open
+
+    def test_weighted_requires_weights(self):
+        e = ReservoirEngine(SamplerConfig(max_sample_size=4, num_reservoirs=2, weighted=True))
+        with pytest.raises(ValueError, match="requires a weights tile"):
+            e.sample(np.zeros((2, 8), np.int32))
+
+    def test_nonpositive_weights_rejected(self):
+        e = ReservoirEngine(SamplerConfig(max_sample_size=4, num_reservoirs=2, weighted=True))
+        with pytest.raises(ValueError, match="strictly positive"):
+            e.sample(np.zeros((2, 8), np.int32), weights=np.zeros((2, 8), np.float32))
+
+    def test_weights_on_unweighted_rejected(self):
+        e = ReservoirEngine(SamplerConfig(max_sample_size=4, num_reservoirs=2))
+        with pytest.raises(ValueError, match="only meaningful"):
+            e.sample(np.zeros((2, 8), np.int32), weights=np.ones((2, 8), np.float32))
+
+    def test_weighted_and_distinct_exclusive(self):
+        with pytest.raises(ValueError):
+            ReservoirEngine(
+                SamplerConfig(max_sample_size=4, num_reservoirs=2, weighted=True, distinct=True)
+            )
+
+
+class TestWeightedBulkPaths:
+    def test_sample_stream_weighted_ragged(self):
+        cfg = SamplerConfig(max_sample_size=4, num_reservoirs=2, tile_size=32, weighted=True)
+        rng = np.random.default_rng(1)
+        elems = rng.integers(0, 1 << 20, (2, 75)).astype(np.int32)
+        w = rng.uniform(0.5, 2.0, (2, 75)).astype(np.float32)
+        a = ReservoirEngine(cfg, key=5)
+        a.sample_stream(elems, weights=w)  # tiles of 32 + masked tail of 11
+        b = ReservoirEngine(cfg, key=5)
+        b.sample_stream(elems, tile_width=75, weights=w)
+        np.testing.assert_array_equal(a.result_arrays()[0], b.result_arrays()[0])
+
+    def test_sample_all_weighted_tuples(self):
+        cfg = SamplerConfig(max_sample_size=4, num_reservoirs=2, weighted=True)
+        e = ReservoirEngine(cfg, key=6)
+        tile = np.arange(2 * 16, dtype=np.int32).reshape(2, 16)
+        w = np.ones((2, 16), np.float32)
+        e.sample_all([(tile, w), (tile + 100, w, np.array([16, 8], np.int32))])
+        samples, sizes = e.result_arrays()
+        assert np.all(sizes == 4)
+
+    def test_sample_stream_weighted_requires_weights(self):
+        cfg = SamplerConfig(max_sample_size=4, num_reservoirs=2, weighted=True)
+        with pytest.raises(ValueError, match="requires a weights"):
+            ReservoirEngine(cfg, key=7).sample_stream(np.zeros((2, 8), np.int32))
