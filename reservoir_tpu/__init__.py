@@ -40,6 +40,10 @@ def __getattr__(name):
         from .engine import ReservoirEngine
 
         return ReservoirEngine
+    if name in ("Sample", "DeviceStreamBridge", "DeviceSampler"):
+        from . import stream
+
+        return getattr(stream, name)
     raise AttributeError(f"module 'reservoir_tpu' has no attribute {name!r}")
 
 
@@ -54,5 +58,8 @@ __all__ = [
     "sampler",
     "distinct",
     "ReservoirEngine",
+    "Sample",
+    "DeviceStreamBridge",
+    "DeviceSampler",
     "__version__",
 ]

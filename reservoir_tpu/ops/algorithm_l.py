@@ -36,7 +36,7 @@ import jax.numpy as jnp
 import jax.random as jr
 import numpy as np
 
-from .rng import accept_draws
+from .rng import accept_draws, uniforms
 
 __all__ = ["ReservoirState", "init", "update", "update_steady", "result", "merge"]
 
@@ -340,8 +340,7 @@ def merge(
 
 
 def _uniform01(key: jax.Array, idx) -> jax.Array:
-    bits = jr.bits(jr.fold_in(key, idx), (), jnp.uint32)
-    return ((bits >> 8).astype(jnp.float32) + 0.5) * float(2.0**-24)
+    return uniforms(key, idx, offset=0.5)
 
 
 def _masked_perm(key: jax.Array, k: int, size) -> jax.Array:

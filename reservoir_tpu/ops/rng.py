@@ -20,9 +20,22 @@ import jax
 import jax.numpy as jnp
 import jax.random as jr
 
-__all__ = ["accept_draws"]
+__all__ = ["accept_draws", "uniform_from_bits", "uniforms"]
 
 _INV_2_24 = float(2.0**-24)
+
+
+def uniform_from_bits(bits: jax.Array, offset: float = 1.0) -> jax.Array:
+    """Map uint32 words onto the 24-bit-mantissa f32 uniform grid (exact in
+    f32).  ``offset=1.0`` gives ``(0, 1]`` (log-safe: ``log(u)`` finite);
+    ``offset=0.5`` gives the open interval ``(0, 1)``.  Single owner of the
+    bits->uniform idiom for every device kernel."""
+    return ((bits >> 8).astype(jnp.float32) + offset) * _INV_2_24
+
+
+def uniforms(key: jax.Array, idx, shape=(), offset: float = 1.0) -> jax.Array:
+    """``shape`` uniforms for the counter-derived key ``fold_in(key, idx)``."""
+    return uniform_from_bits(jr.bits(jr.fold_in(key, idx), shape, jnp.uint32), offset)
 
 
 def accept_draws(key: jax.Array, idx: jax.Array, k: int):
@@ -39,7 +52,7 @@ def accept_draws(key: jax.Array, idx: jax.Array, k: int):
       ``log(u)`` finite.
     """
     bits = jr.bits(jr.fold_in(key, idx), (3,), jnp.uint32)
-    u1 = ((bits[0] >> 8).astype(jnp.float32) + 1.0) * _INV_2_24
-    u2 = ((bits[1] >> 8).astype(jnp.float32) + 1.0) * _INV_2_24
+    u1 = uniform_from_bits(bits[0])
+    u2 = uniform_from_bits(bits[1])
     slot = (bits[2] % jnp.uint32(k)).astype(jnp.int32)
     return slot, u1, u2

@@ -37,9 +37,10 @@ import jax.numpy as jnp
 import jax.random as jr
 import numpy as np
 
+from .rng import uniforms as rng_uniforms
+
 __all__ = ["WeightedState", "init", "update", "update_steady", "result", "merge"]
 
-_INV_2_24 = float(2.0**-24)
 _NEG_INF = float("-inf")
 
 
@@ -56,8 +57,7 @@ class WeightedState(NamedTuple):
 def _uniforms(key: jax.Array, idx) -> jax.Array:
     """Three (0,1] f32 uniforms for absolute index ``idx``:
     [0] fill key, [1] conditional key (r2), [2] jump draw."""
-    bits = jr.bits(jr.fold_in(key, idx), (3,), jnp.uint32)
-    return ((bits >> 8).astype(jnp.float32) + 1.0) * _INV_2_24
+    return rng_uniforms(key, idx, (3,))
 
 
 def init(

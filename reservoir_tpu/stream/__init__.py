@@ -1,0 +1,22 @@
+"""Stream-operator layer — the reference's akka-stream module, TPU-native.
+
+The reference's L2 is ``object Sample`` (``Sample.scala:21-92``): a
+*pass-through* flow re-emitting every upstream element, whose materialized
+value is a ``Future`` of the final sample, with a precise completion protocol
+(``SampleImpl.scala:27-57``).  Here:
+
+- :class:`~reservoir_tpu.stream.operator.Sample` — flow blueprint with eager
+  validation; each ``run()`` materializes a fresh sampler and a future.
+- :class:`~reservoir_tpu.stream.operator.RunningSample` — the materialized
+  pass-through iterator implementing the emit/backpressure/complete/cancel
+  protocol (backpressure = pull-based iteration).
+- :class:`~reservoir_tpu.stream.bridge.DeviceStreamBridge` — the host->device
+  batching layer: S logical streams buffered into ``[R, B]`` tiles feeding a
+  :class:`~reservoir_tpu.engine.ReservoirEngine` (the 65,536-stream scale
+  path, BASELINE.md config 5).
+"""
+
+from .bridge import DeviceSampler, DeviceStreamBridge
+from .operator import RunningSample, Sample
+
+__all__ = ["Sample", "RunningSample", "DeviceStreamBridge", "DeviceSampler"]

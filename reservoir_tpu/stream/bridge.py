@@ -1,0 +1,296 @@
+"""Host->device stream bridge: per-stream buffers, tile-granular flushes.
+
+The reference's stream stage handles one element per actor callback
+(``SampleImpl.scala:27-31``); a TPU cannot be fed that way.  The bridge
+replaces per-element ``onPush`` with **batch flushes**: S logical streams
+buffer on the host into an ``[R=S, B]`` tile, which is dispatched to a
+:class:`~reservoir_tpu.engine.ReservoirEngine` whenever any stream's row
+fills (ragged ``valid`` counts keep partially-filled rows exact).  This is
+the SURVEY §2.4 "host->device stream bridge" component and the scale path
+for BASELINE.md config 5 (65,536 concurrent streams).
+
+The completion protocol survives the batching (SURVEY §5 "failure
+detection" row): the bridge exposes the same tri-state outcome as the
+operator — :meth:`complete` (future succeeds with the per-stream samples),
+:meth:`fail` (future fails with the cause), and a drop-without-completion
+backstop failing it with :class:`AbruptStreamTermination`
+(``SampleImpl.scala:35-57``).
+
+Thread-safety contract matches the reference (``Sampler.scala:19``): one
+writer.  Wrap pushes in your own queue for multi-producer feeds.
+"""
+
+from __future__ import annotations
+
+from concurrent.futures import Future
+from typing import Any, List, Optional, Union
+
+import numpy as np
+
+from ..config import SamplerConfig
+from ..engine import ReservoirEngine
+from ..errors import AbruptStreamTermination, SamplerClosedError
+from ..utils.metrics import BridgeMetrics
+from ..utils.tracing import trace_span
+
+__all__ = ["DeviceStreamBridge", "DeviceSampler"]
+
+
+class DeviceStreamBridge:
+    """S independent logical streams feeding S device reservoirs in lockstep.
+
+    Stream ``s`` owns reservoir row ``s``; elements pushed for it buffer into
+    row ``s`` of a host-side ``[S, B]`` staging tile.  When any row reaches
+    the tile width, the whole tile flushes to the device with per-row
+    ``valid`` counts (padding rows are never sampled — the engine's ragged
+    contract).  State between flushes lives only on the device.
+
+    Args:
+      config: engine config; ``num_reservoirs`` is the stream count.
+      key: PRNG key or seed for the engine.
+      map_fn / hash_fn: traceable hooks forwarded to the engine.
+      reusable: lifecycle switch — reusable bridges allow :meth:`complete`
+        followed by more pushes (snapshot semantics).
+    """
+
+    def __init__(
+        self,
+        config: SamplerConfig,
+        key: Union[int, Any, None] = None,
+        map_fn: Optional[Any] = None,
+        hash_fn: Optional[Any] = None,
+        reusable: bool = False,
+    ) -> None:
+        self._config = config
+        self._engine = ReservoirEngine(
+            config, key=key, map_fn=map_fn, hash_fn=hash_fn, reusable=reusable
+        )
+        self._reusable = reusable
+        S, B = config.num_reservoirs, config.tile_size
+        self._buf = np.zeros((S, B), dtype=np.dtype(config.element_dtype))
+        self._wbuf = np.ones((S, B), np.float32) if config.weighted else None
+        self._fill = np.zeros(S, np.int64)
+        self._future: Future = Future()
+        self._metrics = BridgeMetrics()
+
+    # ------------------------------------------------------------ properties
+
+    @property
+    def num_streams(self) -> int:
+        return self._config.num_reservoirs
+
+    @property
+    def sample(self) -> Future:
+        """The bridge's materialized value: future of the per-stream samples
+        (list of ``S`` arrays), completed by the tri-state protocol."""
+        return self._future
+
+    @property
+    def metrics(self) -> BridgeMetrics:
+        return self._metrics
+
+    @property
+    def is_open(self) -> bool:
+        return self._engine.is_open and not self._future.done()
+
+    def _check_open(self) -> None:
+        if self._future.done():
+            raise SamplerClosedError("this bridge has completed or failed")
+        self._engine._check_open()
+
+    # --------------------------------------------------------------- pushing
+
+    def push(
+        self,
+        stream: int,
+        elements: Any,
+        weights: Optional[Any] = None,
+    ) -> None:
+        """Buffer one element or a 1-D chunk for logical stream ``stream``;
+        flushes automatically whenever the stream's row fills."""
+        self._check_open()
+        self._metrics.start()
+        arr = np.atleast_1d(np.asarray(elements, self._buf.dtype))
+        if self._wbuf is not None:
+            if weights is None:
+                raise ValueError("weighted bridge requires weights")
+            warr = np.atleast_1d(np.asarray(weights, np.float32))
+            if warr.shape != arr.shape:
+                raise ValueError("weights must match elements shape")
+            if not np.all(warr > 0):
+                raise ValueError("weights must be strictly positive")
+        elif weights is not None:
+            raise ValueError("weights are only meaningful with weighted=True")
+        B = self._buf.shape[1]
+        off = 0
+        n = arr.shape[0]
+        while off < n:
+            fill = int(self._fill[stream])
+            take = min(B - fill, n - off)
+            self._buf[stream, fill : fill + take] = arr[off : off + take]
+            if self._wbuf is not None:
+                self._wbuf[stream, fill : fill + take] = warr[off : off + take]
+            self._fill[stream] += take
+            off += take
+            if self._fill[stream] >= B:
+                self.flush()
+        self._metrics.elements += n
+
+    def push_tile(self, tile: Any, valid: Optional[Any] = None,
+                  weights: Optional[Any] = None) -> None:
+        """Bypass buffering: dispatch a pre-assembled ``[S, B]`` tile straight
+        to the device (the zero-copy fast path for array-shaped sources)."""
+        self._check_open()
+        self._metrics.start()
+        tile = np.asarray(tile)
+        with trace_span("reservoir_bridge_flush"):
+            self._engine.sample(tile, valid=valid, weights=weights)
+        n = int(tile.shape[1]) * tile.shape[0] if valid is None else int(
+            np.sum(np.asarray(valid))
+        )
+        self._metrics.elements += n
+        self._metrics.flushed_elements += n
+        self._metrics.flushes += 1
+
+    def flush(self) -> None:
+        """Dispatch buffered elements (ragged tile) to the device."""
+        if not np.any(self._fill):
+            return
+        valid = self._fill.astype(np.int32)
+        with trace_span("reservoir_bridge_flush"):
+            if self._wbuf is not None:
+                self._engine.sample(self._buf, valid=valid, weights=self._wbuf)
+            else:
+                self._engine.sample(self._buf, valid=valid)
+        self._metrics.flushes += 1
+        self._metrics.flushed_elements += int(valid.sum())
+        self._fill[:] = 0
+
+    # ------------------------------------------------------------ completion
+
+    def complete(self) -> List[np.ndarray]:
+        """Upstream completion: flush remainders, fulfill the future with the
+        per-stream samples, and return them (``onUpstreamFinish``,
+        ``SampleImpl.scala:38-41``).  Reusable bridges may continue pushing
+        afterwards (a fresh future is armed)."""
+        self._check_open()
+        self.flush()
+        with trace_span("reservoir_bridge_result"):
+            res = self._engine.result()
+        self._metrics.completions += 1
+        self._future.set_result(res)
+        if self._reusable:
+            self._future = Future()
+        return res
+
+    def fail(self, cause: BaseException) -> None:
+        """Upstream failure: fail the future with ``cause``
+        (``onUpstreamFailure``, ``SampleImpl.scala:43-46``)."""
+        if not self._future.done():
+            self._metrics.failures += 1
+            self._future.set_exception(cause)
+
+    def cancel(self, cause: Optional[BaseException] = None) -> None:
+        """Downstream cancellation (``SampleImpl.scala:48-54``): graceful
+        delivers the partial sample, a cause fails the future."""
+        if self._future.done():
+            return
+        if cause is None:
+            self.complete()
+        else:
+            self.fail(cause)
+
+    def __del__(self) -> None:
+        # postStop backstop (SampleImpl.scala:56-57)
+        fut = getattr(self, "_future", None)
+        if fut is not None and not fut.done():
+            fut.set_exception(
+                AbruptStreamTermination(
+                    "stream bridge dropped without completing"
+                )
+            )
+
+
+class DeviceSampler:
+    """Single-stream :class:`~reservoir_tpu.api.Sampler`-shaped adapter over
+    the device engine — lets the pass-through operator
+    (:class:`~reservoir_tpu.stream.operator.Sample`) sample on TPU.
+
+    Per-element ``sample`` buffers on the host; the device sees fixed-width
+    tiles (static shapes, one compile).  ``result`` flushes the remainder and
+    applies the reference truncation/lifecycle contract.
+    """
+
+    def __init__(
+        self,
+        config: SamplerConfig,
+        key: Union[int, Any, None] = None,
+        reusable: bool = False,
+    ) -> None:
+        if config.num_reservoirs != 1:
+            raise ValueError(
+                "DeviceSampler is single-stream (num_reservoirs=1); use "
+                "DeviceStreamBridge for many streams"
+            )
+        self._engine = ReservoirEngine(config, key=key, reusable=reusable)
+        self._reusable = reusable
+        self._open = True
+        self._buf = np.zeros(config.tile_size, dtype=np.dtype(config.element_dtype))
+        self._fill = 0
+
+    @property
+    def is_open(self) -> bool:
+        return True if self._reusable else self._open
+
+    def _check_open(self) -> None:
+        if not self.is_open:
+            raise SamplerClosedError("this sampler is single-use, and no longer open")
+
+    def _flush(self) -> None:
+        if self._fill:
+            self._engine.sample(
+                self._buf[None, :], valid=np.asarray([self._fill], np.int32)
+            )
+            self._fill = 0
+
+    def sample(self, element: Any) -> None:
+        self._check_open()
+        self._buf[self._fill] = element
+        self._fill += 1
+        if self._fill >= self._buf.shape[0]:
+            self._flush()
+
+    def sample_all(self, elements: Any) -> None:
+        """Bulk path: array-shaped input flushes in whole tiles without the
+        per-element loop (the ``sampleAll`` fast-path analog,
+        ``Sampler.scala:261-287``)."""
+        self._check_open()
+        if not isinstance(elements, np.ndarray) and not hasattr(elements, "__len__"):
+            # generator/iterator source (the Sampler ABC accepts any iterable)
+            for e in elements:
+                self.sample(e)
+            return
+        arr = np.asarray(elements) if not isinstance(elements, np.ndarray) else elements
+        if arr.dtype == object or arr.ndim != 1:
+            for e in np.ravel(arr):
+                self.sample(e)
+            return
+        B = self._buf.shape[0]
+        off = 0
+        n = arr.shape[0]
+        while off < n:
+            take = min(B - self._fill, n - off)
+            self._buf[self._fill : self._fill + take] = arr[off : off + take]
+            self._fill += take
+            off += take
+            if self._fill >= B:
+                self._flush()
+
+    def result(self) -> np.ndarray:
+        self._check_open()
+        self._flush()
+        res = self._engine.result()[0]
+        if not self._reusable:
+            self._open = False
+            self._buf = None  # free (Sampler.scala:345-350)
+        return res

@@ -1,0 +1,12 @@
+"""Auxiliary subsystems: metrics, tracing, checkpointing (SURVEY §5).
+
+The reference has none of these (no logging/metrics dependency, no tracing
+hooks, no checkpointing — SURVEY §5 table); they are mandated additions for
+the TPU framework.  Everything here is dependency-light and optional: the
+core sampling path never requires this package.
+"""
+
+from .metrics import BridgeMetrics
+from .tracing import trace_span
+
+__all__ = ["BridgeMetrics", "trace_span"]

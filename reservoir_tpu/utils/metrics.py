@@ -1,0 +1,45 @@
+"""Lightweight counters for the host<->device stream bridge.
+
+The reference exposes no metrics at all — its only observable state is
+``isOpen`` and the result length (SURVEY §5 "Metrics" row).  The bridge adds
+the counters that matter for a TPU feed path: elements consumed, device
+flushes dispatched, and wall-clock throughput, so a user can see whether the
+host feed or the device kernel is the bottleneck (SURVEY §7.3 warns the
+bridge may be the real bottleneck at 1e9 elem/s).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Dict, Optional
+
+
+@dataclasses.dataclass
+class BridgeMetrics:
+    """Mutable counter block owned by one bridge (single-writer, like the
+    sampler itself — not synchronized)."""
+
+    elements: int = 0
+    flushes: int = 0
+    flushed_elements: int = 0
+    completions: int = 0
+    failures: int = 0
+    _t0: Optional[float] = None
+
+    def start(self) -> None:
+        if self._t0 is None:
+            self._t0 = time.perf_counter()
+
+    def snapshot(self) -> Dict[str, float]:
+        """Point-in-time view, including elements/sec since first element."""
+        elapsed = (time.perf_counter() - self._t0) if self._t0 is not None else 0.0
+        return {
+            "elements": self.elements,
+            "flushes": self.flushes,
+            "flushed_elements": self.flushed_elements,
+            "completions": self.completions,
+            "failures": self.failures,
+            "elapsed_s": elapsed,
+            "elements_per_sec": (self.elements / elapsed) if elapsed > 0 else 0.0,
+        }

@@ -1,0 +1,23 @@
+"""Profiler scopes around bridge/kernel dispatch (SURVEY §5 "Tracing" row).
+
+The reference ships no tracing; its perf story is the JVM inliner.  Here the
+story is XLA + the JAX profiler: named ``TraceAnnotation`` scopes make bridge
+flushes and result gathers visible in a Perfetto trace captured with
+``jax.profiler.start_trace``.  Falls back to a no-op context manager when the
+profiler is unavailable so the hot path never depends on it.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import ContextManager
+
+
+def trace_span(name: str) -> ContextManager[None]:
+    """A named profiler scope (no-op if the JAX profiler is unavailable)."""
+    try:
+        import jax.profiler
+
+        return jax.profiler.TraceAnnotation(name)
+    except Exception:  # pragma: no cover - profiler always present with jax
+        return contextlib.nullcontext()

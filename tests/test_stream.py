@@ -1,0 +1,393 @@
+"""Stream-operator tests — the ``SampleTest.scala`` suite, TPU-native.
+
+Covers the pass-through contract, the materialized future, and the full
+completion protocol (``SampleImpl.scala:27-57``) — including the cases the
+reference leaves untested (SURVEY §4.2 "notable gap"): downstream
+cancellation with/without cause and abrupt termination.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import gc
+
+import numpy as np
+import pytest
+
+from reservoir_tpu import AbruptStreamTermination, SamplerConfig
+from reservoir_tpu.stream import DeviceSampler, DeviceStreamBridge, Sample
+
+
+# ---------------------------------------------------------------- blueprint
+
+
+def test_eager_validation_at_construction():
+    # Sample.scala:52, 89 — invalid params fail at graph construction,
+    # before any source is attached.
+    with pytest.raises(ValueError):
+        Sample(0)
+    with pytest.raises(ValueError):
+        Sample(-5)
+    with pytest.raises(ValueError):
+        Sample.distinct(0)
+    with pytest.raises(TypeError):
+        Sample.distinct(4, hash_fn=42)
+
+
+def test_fresh_sampler_per_materialization():
+    # Sample.scala:23-24 — the sampler expression is captured by name; each
+    # run() gets its own instance and lifecycle.
+    flow = Sample(4, rng=0)
+    r1 = flow.run(range(4)).drain()
+    r2 = flow.run(range(4)).drain()
+    assert sorted(r1) == [0, 1, 2, 3]
+    assert sorted(r2) == [0, 1, 2, 3]
+
+
+# ------------------------------------------------------------- pass-through
+
+
+def test_passthrough_reemits_every_element_in_order():
+    # Sample.scala:13-19: "emits when upstream pushes" — unchanged, in order.
+    run = Sample(3, rng=1).run(range(100))
+    assert list(run) == list(range(100))
+
+
+def test_passthrough_is_pull_based():
+    # "backpressures when downstream backpressures": nothing is consumed
+    # until the downstream pulls.
+    consumed = []
+
+    def source():
+        for i in range(10):
+            consumed.append(i)
+            yield i
+
+    run = Sample(2, rng=2).run(source())
+    assert consumed == []
+    next(run)
+    assert consumed == [0]
+    next(run)
+    assert consumed == [0, 1]
+
+
+# ------------------------------------------------------ completion protocol
+
+
+def test_completes_with_sample_on_upstream_finish():
+    run = Sample(8, rng=3).run(range(5))
+    for _ in run:
+        pass
+    # onUpstreamFinish -> future succeeds (SampleImpl.scala:38-41); n <= k
+    # returns every element (degenerate exactness, SamplerTest.scala:81-91)
+    assert sorted(run.sample.result(timeout=1)) == [0, 1, 2, 3, 4]
+
+
+def test_sample_has_size_k_for_long_streams():
+    res = Sample(16, rng=4).run(range(1000)).drain()
+    assert len(res) == 16
+    assert all(0 <= x < 1000 for x in res)
+    assert len(set(res)) == 16  # distinct indices of a dup-free stream
+
+
+def test_upstream_failure_fails_future_and_propagates():
+    # onUpstreamFailure (SampleImpl.scala:43-46)
+    boom = RuntimeError("upstream exploded")
+
+    def source():
+        yield 1
+        yield 2
+        raise boom
+
+    run = Sample(4, rng=5).run(source())
+    with pytest.raises(RuntimeError, match="upstream exploded"):
+        for _ in run:
+            pass
+    assert run.sample.exception(timeout=1) is boom
+
+
+def test_graceful_downstream_cancel_delivers_partial_sample():
+    # onDownstreamFinish with NonFailureCancellation (SampleImpl.scala:48-54)
+    run = Sample(10, rng=6).run(range(1000))
+    for _ in range(5):
+        next(run)
+    run.cancel()
+    assert sorted(run.sample.result(timeout=1)) == [0, 1, 2, 3, 4]
+    # idempotent; iteration after cancel terminates
+    run.cancel()
+    assert list(run) == []
+
+
+def test_downstream_cancel_with_cause_fails_future():
+    cause = ValueError("downstream gave up")
+    run = Sample(10, rng=7).run(range(1000))
+    next(run)
+    run.cancel(cause)
+    assert run.sample.exception(timeout=1) is cause
+
+
+def test_abrupt_termination_backstop():
+    # postStop (SampleImpl.scala:56-57): operator dropped without any
+    # completion path -> AbruptStreamTermination.
+    run = Sample(4, rng=8).run(range(100))
+    next(run)
+    fut = run.sample
+    del run
+    gc.collect()
+    assert isinstance(fut.exception(timeout=1), AbruptStreamTermination)
+
+
+def test_sampler_error_fails_future():
+    flow = Sample.from_factory(lambda: _ExplodingSampler())
+    run = flow.run(range(10))
+    with pytest.raises(RuntimeError, match="sampler exploded"):
+        next(run)
+    assert isinstance(run.sample.exception(timeout=1), RuntimeError)
+
+
+class _ExplodingSampler:
+    is_open = True
+
+    def sample(self, element):
+        raise RuntimeError("sampler exploded")
+
+    def result(self):  # pragma: no cover
+        return []
+
+
+# ----------------------------------------------------------------- distinct
+
+
+def test_distinct_flow_collapses_duplicates():
+    # SamplerTest.scala:319-339 analog at the stream layer
+    res = Sample.distinct(8, rng=9).run([7] * 100).drain()
+    assert res == [7]
+
+
+def test_dup_flow_keeps_duplicates():
+    res = Sample(10, rng=10).run([7] * 10).drain()
+    assert res == [7] * 10
+
+
+def test_map_fn_applies():
+    res = Sample(10, rng=11, map_fn=lambda x: x * 2).run(range(5)).drain()
+    assert sorted(res) == [0, 2, 4, 6, 8]
+
+
+# -------------------------------------------------------------- statistical
+
+
+def test_element_after_k_is_sometimes_but_not_always_sampled():
+    # SampleTest.scala sometimes/not-always boundary tests; failure odds for
+    # 200 trials of k/n = 3/6 are (1/2)^200 each way.
+    hits = 0
+    for trial in range(200):
+        res = Sample(3, rng=1000 + trial).run(range(6)).drain()
+        hits += 5 in res
+    assert 0 < hits < 200
+
+
+def test_stream_uniformity_5sigma():
+    # Scaled-down analog of SampleTest.scala:99-205: sample half of 10
+    # elements repeatedly; per-element counts within 5 sigma.
+    trials, n, k = 4000, 10, 5
+    counts = np.zeros(n)
+    flow = Sample(k)
+    for t in range(trials):
+        for x in flow.run(range(n)).drain():
+            counts[x] += 1
+    expect = trials * k / n
+    sigma = np.sqrt(trials * (k / n) * (1 - k / n))
+    assert np.all(np.abs(counts - expect) < 5 * sigma)
+
+
+# -------------------------------------------------------------------- async
+
+
+def test_async_run_completes():
+    async def go():
+        async def source():
+            for i in range(50):
+                yield i
+
+        run = Sample(8, rng=12).run_async(source())
+        seen = [x async for x in run]
+        assert seen == list(range(50))
+        return run.sample.result(timeout=1)
+
+    res = asyncio.run(go())
+    assert len(res) == 8
+
+
+def test_async_upstream_failure():
+    async def go():
+        async def source():
+            yield 1
+            raise RuntimeError("async boom")
+
+        run = Sample(8, rng=13).run_async(source())
+        with pytest.raises(RuntimeError, match="async boom"):
+            async for _ in run:
+                pass
+        return run.sample
+
+    fut = asyncio.run(go())
+    assert isinstance(fut.exception(timeout=1), RuntimeError)
+
+
+# ------------------------------------------------------------ device sampler
+
+
+def test_device_flow_degenerate_exact():
+    res = Sample.device(16, key=0, tile_size=8).run(range(10)).drain()
+    assert sorted(int(x) for x in res) == list(range(10))
+
+
+def test_device_flow_long_stream():
+    res = Sample.device(8, key=1, tile_size=32).run(range(500)).drain()
+    assert len(res) == 8
+    assert all(0 <= int(x) < 500 for x in res)
+
+
+def test_device_flow_distinct():
+    res = Sample.device(8, key=2, tile_size=16, distinct=True).run(
+        [5] * 40 + [9] * 40
+    ).drain()
+    assert sorted(int(x) for x in res) == [5, 9]
+
+
+def test_device_sampler_bulk_equals_streamwise_feed():
+    # the engine's tile-split invariance surfaces here: per-element sample()
+    # and array sample_all() agree bit-for-bit under the same key
+    cfg = SamplerConfig(max_sample_size=8, num_reservoirs=1, tile_size=16)
+    a = DeviceSampler(cfg, key=3)
+    b = DeviceSampler(cfg, key=3)
+    data = np.arange(200, dtype=np.int32)
+    for x in data:
+        a.sample(x)
+    b.sample_all(data)
+    assert np.array_equal(a.result(), b.result())
+
+
+def test_device_sampler_sample_all_accepts_generators():
+    # the Sampler ABC contract takes any iterable (api.py), including
+    # one-shot iterators — must not crash in the array fast path
+    cfg = SamplerConfig(max_sample_size=8, num_reservoirs=1, tile_size=16)
+    a = DeviceSampler(cfg, key=3)
+    b = DeviceSampler(cfg, key=3)
+    a.sample_all(iter(range(200)))
+    b.sample_all(np.arange(200, dtype=np.int32))
+    assert np.array_equal(a.result(), b.result())
+
+
+def test_device_sampler_single_use_lifecycle():
+    from reservoir_tpu import SamplerClosedError
+
+    cfg = SamplerConfig(max_sample_size=4, num_reservoirs=1, tile_size=8)
+    s = DeviceSampler(cfg, key=4)
+    s.sample(1)
+    s.result()
+    assert not s.is_open
+    with pytest.raises(SamplerClosedError):
+        s.sample(2)
+
+
+# ------------------------------------------------------------------- bridge
+
+
+def test_bridge_many_streams_complete():
+    cfg = SamplerConfig(max_sample_size=4, num_reservoirs=8, tile_size=16)
+    bridge = DeviceStreamBridge(cfg, key=5)
+    for s in range(8):
+        bridge.push(s, np.arange(s * 100, s * 100 + 50, dtype=np.int32))
+    res = bridge.complete()
+    assert len(res) == 8
+    for s, r in enumerate(res):
+        assert len(r) == 4
+        assert all(s * 100 <= int(x) < s * 100 + 50 for x in r)
+    assert bridge.sample.result(timeout=1) is res
+
+
+def test_bridge_ragged_streams_exact_below_k():
+    cfg = SamplerConfig(max_sample_size=8, num_reservoirs=4, tile_size=8)
+    bridge = DeviceStreamBridge(cfg, key=6)
+    lengths = [0, 3, 8, 5]
+    for s, n in enumerate(lengths):
+        for i in range(n):
+            bridge.push(s, i)
+    res = bridge.complete()
+    for s, n in enumerate(lengths):
+        assert sorted(int(x) for x in res[s]) == list(range(n))
+
+
+def test_bridge_autoflush_and_metrics():
+    cfg = SamplerConfig(max_sample_size=4, num_reservoirs=2, tile_size=8)
+    bridge = DeviceStreamBridge(cfg, key=7)
+    bridge.push(0, np.arange(20, dtype=np.int32))  # 2 full tiles + remainder
+    assert bridge.metrics.flushes >= 2
+    bridge.complete()
+    m = bridge.metrics.snapshot()
+    assert m["elements"] == 20
+    assert m["flushed_elements"] == 20
+    assert m["completions"] == 1
+
+
+def test_bridge_failure_protocol():
+    cfg = SamplerConfig(max_sample_size=4, num_reservoirs=2, tile_size=8)
+    bridge = DeviceStreamBridge(cfg, key=8)
+    bridge.push(0, 1)
+    boom = RuntimeError("feed died")
+    bridge.fail(boom)
+    assert bridge.sample.exception(timeout=1) is boom
+    from reservoir_tpu import SamplerClosedError
+
+    with pytest.raises(SamplerClosedError):
+        bridge.push(0, 2)
+
+
+def test_bridge_graceful_cancel_delivers_partial():
+    cfg = SamplerConfig(max_sample_size=8, num_reservoirs=2, tile_size=8)
+    bridge = DeviceStreamBridge(cfg, key=9)
+    bridge.push(0, np.arange(3, dtype=np.int32))
+    bridge.cancel()
+    res = bridge.sample.result(timeout=1)
+    assert sorted(int(x) for x in res[0]) == [0, 1, 2]
+    assert len(res[1]) == 0
+
+
+def test_bridge_abrupt_backstop():
+    cfg = SamplerConfig(max_sample_size=4, num_reservoirs=2, tile_size=8)
+    bridge = DeviceStreamBridge(cfg, key=10)
+    bridge.push(0, 1)
+    fut = bridge.sample
+    del bridge
+    gc.collect()
+    assert isinstance(fut.exception(timeout=1), AbruptStreamTermination)
+
+
+def test_bridge_weighted_streams():
+    cfg = SamplerConfig(
+        max_sample_size=4, num_reservoirs=2, tile_size=8, weighted=True
+    )
+    bridge = DeviceStreamBridge(cfg, key=11)
+    bridge.push(0, np.arange(6, dtype=np.int32), weights=np.ones(6, np.float32))
+    with pytest.raises(ValueError):
+        bridge.push(1, 1)  # missing weights
+    with pytest.raises(ValueError):
+        bridge.push(1, 1, weights=-1.0)
+    res = bridge.complete()
+    assert len(res[0]) == 4
+    assert all(0 <= int(x) < 6 for x in res[0])
+
+
+def test_bridge_reusable_snapshots():
+    cfg = SamplerConfig(max_sample_size=8, num_reservoirs=2, tile_size=8)
+    bridge = DeviceStreamBridge(cfg, key=12, reusable=True)
+    bridge.push(0, np.arange(3, dtype=np.int32))
+    first = bridge.complete()
+    bridge.push(0, np.arange(3, 6, dtype=np.int32))
+    second = bridge.complete()
+    # earlier snapshot not clobbered (copy-on-write guarantee,
+    # Sampler.scala:353-381 — structural here)
+    assert sorted(int(x) for x in first[0]) == [0, 1, 2]
+    assert sorted(int(x) for x in second[0]) == [0, 1, 2, 3, 4, 5]
