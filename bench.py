@@ -12,6 +12,9 @@ Prints exactly ONE JSON line:
 
 Env knobs:
   RESERVOIR_BENCH_SMOKE=1   tiny shapes for a CPU smoke run
+  RESERVOIR_BENCH_PLATFORM=cpu  force the CPU backend (config.update — the
+                            JAX_PLATFORMS env var is claimed by the axon
+                            sitecustomize and must not be overridden)
   RESERVOIR_BENCH_R/K/B/STEPS  override the config
 """
 
@@ -23,6 +26,10 @@ import sys
 import time
 
 import jax
+
+if os.environ.get("RESERVOIR_BENCH_PLATFORM"):
+    jax.config.update("jax_platforms", os.environ["RESERVOIR_BENCH_PLATFORM"])
+
 import jax.numpy as jnp
 import jax.random as jr
 

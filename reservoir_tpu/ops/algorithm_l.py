@@ -36,7 +36,7 @@ import jax.numpy as jnp
 import jax.random as jr
 import numpy as np
 
-from .rng import accept_draws, uniforms
+from .rng import accept_draws_words, key_words, uniforms
 
 __all__ = ["ReservoirState", "init", "update", "update_steady", "result", "merge"]
 
@@ -71,15 +71,27 @@ class ReservoirState(NamedTuple):
 
 
 def _advance(log_w: jax.Array, nxt: jax.Array, key: jax.Array, idx, k: int):
+    """:func:`_advance_words` on a typed jax key."""
+    k1, k2 = key_words(key)
+    return _advance_words(log_w, nxt, k1, k2, idx, k)
+
+
+def _advance_words(
+    log_w: jax.Array, nxt: jax.Array, k1: jax.Array, k2: jax.Array, idx, k: int
+):
     """Algorithm-L skip recomputation (``Sampler.scala:228-236``) using the
     draws assigned to accept-index ``idx``.
 
     ``W *= u1^(1/k)`` in log-space; ``next += floor(log(u2)/log(1-W)) + 1``
     with saturating integer arithmetic (no wraparound past dtype max).
+
+    Raw-key-words form, elementwise over lanes — the *same trace* runs inside
+    the XLA vmap path and the Pallas kernel, which is what makes the two
+    bit-identical (``tests/test_pallas_algl.py``).
     """
     dtype = nxt.dtype
     maxval = np.iinfo(dtype).max
-    slot, u1, u2 = accept_draws(key, idx, k)
+    slot, u1, u2 = accept_draws_words(k1, k2, idx, k)
     log_w = log_w + jnp.log(u1) / k
     w = jnp.exp(log_w)
     # w rounding to exactly 1.0 gives log1p(-1) = -inf -> skip 0; fine.

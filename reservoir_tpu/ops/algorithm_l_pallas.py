@@ -1,0 +1,183 @@
+"""Pallas TPU kernel for the Algorithm-L steady-state hot path (SURVEY §7.2 M4).
+
+Why a kernel at all: the XLA vmap path (:mod:`.algorithm_l`) carries
+``samples [R, k]`` through a batched ``while_loop``, and XLA's batched-loop
+lowering applies a per-lane select over the *entire* carry on every
+iteration — ~3 × R × k × 4 bytes of HBM traffic per acceptance round.  Here
+the reservoir block lives in VMEM for the whole tile: acceptances mutate the
+ref in place, so per-tile HBM traffic drops to exactly one read of the batch
+tile plus one read+write of the state block — the minimum the algorithm
+admits.
+
+Bit-equivalence with the vmap path is by construction, not by luck: both
+paths run the *same* ``_advance_words`` trace (threefry counter draws keyed
+on the absolute accept index, :mod:`reservoir_tpu.ops.threefry`), so
+``update_steady_pallas(state, tile) == update_steady(state, tile)`` holds
+exactly — pinned by ``tests/test_pallas_algl.py`` in interpret mode on CPU
+and re-checked on device.
+
+Scope (the engine falls back to the XLA path otherwise): steady state only
+(every reservoir past its fill phase — the reference's hot regime,
+``Sampler.scala:257``), full tiles (no ``valid`` raggedness), identity
+``map_fn``, int32 counters, and R divisible by the row-block size.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .algorithm_l import ReservoirState, _advance_words
+from .rng import key_words
+
+__all__ = ["supports", "update_steady_pallas"]
+
+_DEFAULT_BLOCK_R = 64
+
+
+def supports(
+    state: ReservoirState,
+    valid,
+    map_fn,
+    block_r: int = _DEFAULT_BLOCK_R,
+    batch: "jax.Array | None" = None,
+) -> bool:
+    """True iff this kernel can take the tile (else: XLA path)."""
+    return (
+        valid is None
+        and map_fn is None
+        and state.count.dtype == jnp.int32
+        and state.samples.dtype in (jnp.int32, jnp.float32, jnp.uint32)
+        and (batch is None or batch.dtype == state.samples.dtype)
+        and state.num_reservoirs % block_r == 0
+    )
+
+
+def _kernel(samples_ref, count_ref, nxt_ref, logw_ref, key_ref, batch_ref,
+            out_samples_ref, out_nxt_ref, out_logw_ref, *, k: int, block_b: int):
+    """One grid cell = one ``[block_r]`` row-block of reservoirs × one tile.
+
+    All per-reservoir scalars are ``[block_r, 1]`` columns (TPU wants >= 2-D);
+    the acceptance loop is lockstep over the block's lanes with masked
+    updates — a lane whose chain is done rides along untouched, the exact
+    semantics of the vmapped ``while_loop`` it replaces.
+    """
+    count = count_ref[:, :]            # [r, 1] int32 (pre-tile count)
+    end = count + jnp.int32(block_b)
+    k1 = key_ref[:, 0:1]
+    k2 = key_ref[:, 1:2]
+    block_r = count.shape[0]
+
+    lane_b = jax.lax.broadcasted_iota(jnp.int32, (block_r, block_b), 1)
+    lane_k = jax.lax.broadcasted_iota(jnp.int32, (block_r, k), 1)
+
+    # out refs start as copies of the inputs; acceptances mutate in place.
+    out_samples_ref[:, :] = samples_ref[:, :]
+
+    def cond(carry):
+        nxt, _ = carry
+        return jnp.any(nxt <= end)
+
+    def body(carry):
+        nxt, log_w = carry
+        active = nxt <= end                       # [r, 1]
+        pos = nxt - count - 1                     # [r, 1] in [0, B) when active
+        # gather batch[r, pos_r] as a one-hot masked reduction (no per-row
+        # dynamic gather on the VPU)
+        onehot = lane_b == pos
+        # one-hot gather as an integer bit-pattern sum: exactly one lane is
+        # selected and the rest contribute literal zero, so summing the
+        # bitcast int32 words is exact for every dtype — including the
+        # float32 -0.0 sign bit, which a float sum would drop (-0.0 + 0.0
+        # == +0.0 in IEEE)
+        batch_bits = jax.lax.bitcast_convert_type(batch_ref[:, :], jnp.int32)
+        elem_bits = jnp.sum(
+            jnp.where(onehot, batch_bits, 0), axis=1, keepdims=True
+        )
+        elem = jax.lax.bitcast_convert_type(elem_bits, batch_ref.dtype)
+        slot, log_w_n, nxt_n = _advance_words(log_w, nxt, k1, k2, nxt, k)
+        write = (lane_k == slot) & active
+        out_samples_ref[:, :] = jnp.where(
+            write, elem.astype(out_samples_ref.dtype), out_samples_ref[:, :]
+        )
+        return (
+            jnp.where(active, nxt_n, nxt),
+            jnp.where(active, log_w_n, log_w),
+        )
+
+    nxt, log_w = jax.lax.while_loop(cond, body, (nxt_ref[:, :], logw_ref[:, :]))
+    out_nxt_ref[:, :] = nxt
+    out_logw_ref[:, :] = log_w
+
+
+def update_steady_pallas(
+    state: ReservoirState,
+    batch: jax.Array,
+    *,
+    block_r: int = _DEFAULT_BLOCK_R,
+    interpret: bool = False,
+) -> ReservoirState:
+    """Steady-state tile update, bit-identical to
+    :func:`reservoir_tpu.ops.algorithm_l.update_steady` on full tiles.
+
+    ``batch`` is ``[R, B]``; reservoir r consumes its full row.  Requires
+    :func:`supports`; ``interpret=True`` runs the Mosaic interpreter (CPU
+    equivalence tests).
+    """
+    R, k = state.samples.shape
+    B = batch.shape[1]
+    if batch.shape[0] != R:
+        raise ValueError(
+            f"batch has {batch.shape[0]} rows for {R} reservoirs"
+        )
+    if not supports(state, None, None, block_r, batch):
+        raise ValueError(
+            "update_steady_pallas: unsupported config (need int32 counters, "
+            f"int32/float32/uint32 samples, batch dtype == samples dtype, "
+            f"R % {block_r} == 0); use ops.algorithm_l.update_steady"
+        )
+    kd1, kd2 = key_words(state.key)               # [R] uint32 each
+    key_data = jnp.stack([kd1, kd2], axis=1)      # [R, 2]
+
+    col = lambda i: (i, 0)  # noqa: E731 — row-block i, full second axis
+    col_spec = lambda w: pl.BlockSpec(  # noqa: E731
+        (block_r, w), col, memory_space=pltpu.VMEM
+    )
+
+    out_samples, out_nxt, out_logw = pl.pallas_call(
+        functools.partial(_kernel, k=k, block_b=B),
+        grid=(R // block_r,),
+        in_specs=[
+            col_spec(k),
+            col_spec(1),
+            col_spec(1),
+            col_spec(1),
+            col_spec(2),
+            col_spec(B),
+        ],
+        out_specs=(col_spec(k), col_spec(1), col_spec(1)),
+        out_shape=(
+            jax.ShapeDtypeStruct((R, k), state.samples.dtype),
+            jax.ShapeDtypeStruct((R, 1), state.nxt.dtype),
+            jax.ShapeDtypeStruct((R, 1), state.log_w.dtype),
+        ),
+        interpret=interpret,
+    )(
+        state.samples,
+        state.count.reshape(R, 1),
+        state.nxt.reshape(R, 1),
+        state.log_w.reshape(R, 1),
+        key_data,
+        batch,
+    )
+    return ReservoirState(
+        samples=out_samples,
+        count=state.count + jnp.asarray(B, state.count.dtype),
+        nxt=out_nxt.reshape(R),
+        log_w=out_logw.reshape(R),
+        key=state.key,
+    )

@@ -20,22 +20,51 @@ import jax
 import jax.numpy as jnp
 import jax.random as jr
 
-__all__ = ["accept_draws", "uniform_from_bits", "uniforms"]
+from .threefry import counter_bits
+
+__all__ = [
+    "accept_draws",
+    "accept_draws_words",
+    "key_words",
+    "uniform_from_bits",
+    "uniforms",
+]
 
 _INV_2_24 = float(2.0**-24)
+
+
+def key_words(key: jax.Array):
+    """Raw uint32 word pair of a typed jax key (what crosses into Pallas)."""
+    data = jr.key_data(key)
+    return data[..., 0], data[..., 1]
 
 
 def uniform_from_bits(bits: jax.Array, offset: float = 1.0) -> jax.Array:
     """Map uint32 words onto the 24-bit-mantissa f32 uniform grid (exact in
     f32).  ``offset=1.0`` gives ``(0, 1]`` (log-safe: ``log(u)`` finite);
     ``offset=0.5`` gives the open interval ``(0, 1)``.  Single owner of the
-    bits->uniform idiom for every device kernel."""
-    return ((bits >> 8).astype(jnp.float32) + offset) * _INV_2_24
+    bits->uniform idiom for every device kernel.
+
+    The cast routes through int32 (exact: the shifted value is < 2^24)
+    because Mosaic has no uint32->f32 lowering."""
+    return ((bits >> 8).astype(jnp.int32).astype(jnp.float32) + offset) * _INV_2_24
 
 
 def uniforms(key: jax.Array, idx, shape=(), offset: float = 1.0) -> jax.Array:
-    """``shape`` uniforms for the counter-derived key ``fold_in(key, idx)``."""
-    return uniform_from_bits(jr.bits(jr.fold_in(key, idx), shape, jnp.uint32), offset)
+    """``shape`` uniforms for the counter-derived key ``fold_in(key, idx)``.
+
+    Backed by :mod:`reservoir_tpu.ops.threefry` (bit-identical to
+    ``jr.bits(jr.fold_in(key, idx), shape, uint32)`` — pinned by
+    ``tests/test_threefry.py``); only scalar-or-``(n,)`` shapes are needed by
+    the kernels.
+    """
+    if len(shape) > 1:
+        raise ValueError(f"uniforms supports scalar or (n,) shapes, got {shape}")
+    k1, k2 = key_words(key)
+    n = 1 if shape == () else int(shape[0])
+    words = counter_bits(k1, k2, idx, n)
+    stacked = words[0] if shape == () else jnp.stack(words)
+    return uniform_from_bits(stacked, offset)
 
 
 def accept_draws(key: jax.Array, idx: jax.Array, k: int):
@@ -50,9 +79,21 @@ def accept_draws(key: jax.Array, idx: jax.Array, k: int):
       exact in f32) feeding the Algorithm-L ``W``/skip update
       (``Sampler.scala:228-236``).  The half-open-at-zero range keeps
       ``log(u)`` finite.
+
+    Shared bit-for-bit between the XLA vmap kernel and the Pallas kernel via
+    :func:`reservoir_tpu.ops.threefry.counter_bits`.
     """
-    bits = jr.bits(jr.fold_in(key, idx), (3,), jnp.uint32)
-    u1 = uniform_from_bits(bits[0])
-    u2 = uniform_from_bits(bits[1])
-    slot = (bits[2] % jnp.uint32(k)).astype(jnp.int32)
+    k1, k2 = key_words(key)
+    return accept_draws_words(k1, k2, idx, k)
+
+
+def accept_draws_words(k1: jax.Array, k2: jax.Array, idx: jax.Array, k: int):
+    """:func:`accept_draws` on raw uint32 key words, elementwise over ``idx``
+    lanes — the form shared with the Pallas kernel (typed keys cannot cross a
+    ``pallas_call`` boundary).  64-bit ``idx`` keeps fresh draws past 2^32
+    (see :func:`reservoir_tpu.ops.threefry.fold_in_words`)."""
+    w0, w1, w2 = counter_bits(k1, k2, idx, 3)
+    u1 = uniform_from_bits(w0)
+    u2 = uniform_from_bits(w1)
+    slot = (w2 % jnp.uint32(k)).astype(jnp.int32)
     return slot, u1, u2

@@ -1,0 +1,110 @@
+"""Pure-jnp Threefry-2x32 — the framework's one counter-based RNG core.
+
+The reference threads a sequential ``scala.util.Random`` through its hot loop
+(``Sampler.scala:199, 228-236``); this framework keys every draw on a counter
+instead (see :mod:`reservoir_tpu.ops.rng`).  The cipher here is the same
+Threefry-2x32 that backs ``jax.random`` — re-implemented with plain jnp
+bitwise ops so that the *identical* math runs in three places:
+
+- the XLA vmap kernels (:mod:`reservoir_tpu.ops.algorithm_l`),
+- the Pallas TPU kernel (:mod:`reservoir_tpu.ops.algorithm_l_pallas`), whose
+  traced body cannot call ``jax.random`` primitives, and
+- any host-side oracle that wants draw parity.
+
+Bit-compatibility with ``jax.random`` (threefry impl, partitionable mode) is
+pinned by ``tests/test_threefry.py``: ``fold_in_words`` matches
+``jr.key_data(jr.fold_in(key, idx))`` and ``bits_words`` matches
+``jr.bits(key, (n,), uint32)`` word-for-word.  That equality is what makes
+"vmap path == Pallas path" testable bit-for-bit rather than statistically.
+
+All functions take raw ``uint32`` key words (``jr.key_data(key)``), never
+typed key arrays — typed keys cannot cross a ``pallas_call`` boundary.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["threefry2x32", "fold_in_words", "bits_words", "counter_bits"]
+
+_PARITY = np.uint32(0x1BD11BDA)
+# Rotation schedule for Threefry-2x32, 20 rounds in 5 groups of 4.
+_ROTATIONS = ((13, 15, 26, 6), (17, 29, 16, 24))
+
+
+def _rotl(x: jax.Array, d: int) -> jax.Array:
+    return (x << np.uint32(d)) | (x >> np.uint32(32 - d))
+
+
+def threefry2x32(
+    k1: jax.Array, k2: jax.Array, x0: jax.Array, x1: jax.Array
+) -> Tuple[jax.Array, jax.Array]:
+    """Hash independent 2-word blocks ``(x0, x1)`` under key ``(k1, k2)``.
+
+    Elementwise over broadcastable uint32 arrays — each lane is one block,
+    exactly the semantics of jax's ``threefry2x32_p`` primitive.
+    """
+    ks0 = jnp.asarray(k1, jnp.uint32)
+    ks1 = jnp.asarray(k2, jnp.uint32)
+    ks2 = ks0 ^ ks1 ^ _PARITY
+    ks = (ks0, ks1, ks2)
+    x0 = jnp.asarray(x0, jnp.uint32) + ks0
+    x1 = jnp.asarray(x1, jnp.uint32) + ks1
+    for group in range(5):
+        for r in _ROTATIONS[group % 2]:
+            x0 = x0 + x1
+            x1 = _rotl(x1, r) ^ x0
+        x0 = x0 + ks[(group + 1) % 3]
+        x1 = x1 + ks[(group + 2) % 3] + np.uint32(group + 1)
+    return x0, x1
+
+
+def fold_in_words(
+    k1: jax.Array, k2: jax.Array, idx: jax.Array
+) -> Tuple[jax.Array, jax.Array]:
+    """``jr.fold_in(key, idx)`` on raw words: one block hash of the seed pair
+    ``[idx >> 32, idx & 0xffffffff]`` (jax's ``threefry_seed`` layout; the
+    high word of a 32-bit index is 0).
+
+    Deliberate improvement over ``jr.fold_in``, which casts its operand to
+    uint32 and therefore repeats draws with period 2^32: for 64-bit ``idx``
+    the high word is folded in too, so int64 streams past 2^32 elements per
+    reservoir keep fresh draws.  Identical to jax for any idx < 2^32.
+    """
+    idx = jnp.asarray(idx)
+    lo = idx.astype(jnp.uint32)
+    if idx.dtype.itemsize == 8:
+        hi = (idx >> 32).astype(jnp.uint32)
+    else:
+        hi = jnp.zeros_like(lo)
+    return threefry2x32(k1, k2, hi, lo)
+
+
+def bits_words(k1: jax.Array, k2: jax.Array, n: int):
+    """``jr.bits(key, (n,), uint32)`` on raw words, for small static ``n``:
+    word ``j`` comes from block ``(0, j)`` as ``out0 ^ out1`` (jax's
+    partitionable counter layout: 64-bit iota split hi/lo, xor-folded).
+
+    Returns a tuple of ``n`` arrays shaped like ``k1`` — kept separate (not
+    stacked) so callers inside Pallas stay free of reshapes.
+    """
+    words = []
+    zero = jnp.zeros_like(jnp.asarray(k1, jnp.uint32))
+    for j in range(n):
+        b0, b1 = threefry2x32(k1, k2, zero, zero + np.uint32(j))
+        words.append(b0 ^ b1)
+    return tuple(words)
+
+
+def counter_bits(k1: jax.Array, k2: jax.Array, idx: jax.Array, n: int):
+    """The framework's standard per-event draw: ``n`` uint32 words for the
+    counter-derived key ``fold_in(key, idx)`` — elementwise over ``idx``
+    lanes.  Equals ``jr.bits(jr.fold_in(key, idx), (n,), uint32)`` for
+    idx < 2^32; for 64-bit ``idx`` the full index is folded in (see
+    :func:`fold_in_words`)."""
+    f1, f2 = fold_in_words(k1, k2, idx)
+    return bits_words(f1, f2, n)

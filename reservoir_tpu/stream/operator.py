@@ -31,7 +31,7 @@ from typing import Any, AsyncIterable, Callable, Iterable, Optional, Union
 import numpy as np
 
 from ..config import validate_non_distinct_params
-from ..errors import AbruptStreamTermination
+from ..errors import AbruptStreamTermination, SamplerClosedError
 
 __all__ = ["Sample", "RunningSample", "AsyncRunningSample"]
 
@@ -170,6 +170,16 @@ class _RunningBase:
                 self._future.set_result(self._sampler.result())
             except BaseException as exc:  # result() itself failed
                 self._future.set_exception(exc)
+        else:
+            # A closed sampler at completion means the factory violated the
+            # fresh-sampler-per-run contract; fail loudly rather than leave
+            # the future forever pending (drain() would deadlock).
+            self._future.set_exception(
+                SamplerClosedError(
+                    "sampler was already closed at stream completion; "
+                    "factories must produce a fresh sampler per run"
+                )
+            )
 
     def _fail(self, exc: BaseException) -> None:
         if not self._future.done():

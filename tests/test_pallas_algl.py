@@ -1,0 +1,123 @@
+"""Pallas steady-state kernel == XLA vmap kernel, bit for bit.
+
+The TPU-native version of the reference's ``sample == sampleAll`` contract
+(``SamplerTest.scala:117-142``): the two implementations consume identical
+counter-keyed draws (shared ``_advance_words`` trace), so equality is exact,
+not statistical.  Runs the Mosaic interpreter on the CPU test mesh.
+"""
+
+import jax
+import jax.numpy as jnp
+import jax.random as jr
+import numpy as np
+import pytest
+
+from reservoir_tpu.ops import algorithm_l as al
+from reservoir_tpu.ops import algorithm_l_pallas as alp
+
+
+def _fill(key, R, k, B, seed_elems=0):
+    state = al.init(key, R, k)
+    batch = seed_elems + jax.lax.broadcasted_iota(jnp.int32, (R, B), 1)
+    return al.update(state, batch), R * 0 + B
+
+
+def _assert_state_equal(a, b):
+    np.testing.assert_array_equal(np.asarray(a.samples), np.asarray(b.samples))
+    np.testing.assert_array_equal(np.asarray(a.count), np.asarray(b.count))
+    np.testing.assert_array_equal(np.asarray(a.nxt), np.asarray(b.nxt))
+    np.testing.assert_array_equal(np.asarray(a.log_w), np.asarray(b.log_w))
+
+
+@pytest.mark.parametrize("R,k,B", [(8, 16, 64), (16, 8, 32), (8, 128, 256)])
+def test_pallas_matches_vmap_dense_accepts(R, k, B):
+    # Right after fill: many acceptances per tile (stress the loop).
+    state, _ = _fill(jr.key(0), R, k, B)
+    batch = 10_000 + jax.lax.broadcasted_iota(jnp.int32, (R, B), 1)
+    ref = al.update_steady(state, batch)
+    got = alp.update_steady_pallas(state, batch, block_r=8, interpret=True)
+    _assert_state_equal(ref, got)
+
+
+def test_pallas_matches_vmap_sparse_accepts():
+    # High count: most tiles see zero acceptances (the skip fast path).
+    R, k, B = 8, 16, 64
+    state, _ = _fill(jr.key(1), R, k, B)
+    # advance count far without touching samples: replay many tiles via XLA
+    for s in range(30):
+        batch = s * B + jax.lax.broadcasted_iota(jnp.int32, (R, B), 1)
+        state = al.update_steady(state, batch)
+    batch = 999_000 + jax.lax.broadcasted_iota(jnp.int32, (R, B), 1)
+    ref = al.update_steady(state, batch)
+    got = alp.update_steady_pallas(state, batch, block_r=8, interpret=True)
+    _assert_state_equal(ref, got)
+
+
+def test_pallas_multi_tile_chain():
+    # Chained tiles through the Pallas path stay identical to the XLA chain.
+    R, k, B = 8, 8, 32
+    state, _ = _fill(jr.key(2), R, k, B)
+    s_ref = s_pal = state
+    for s in range(6):
+        batch = (100 + s) * B + jax.lax.broadcasted_iota(jnp.int32, (R, B), 1)
+        s_ref = al.update_steady(s_ref, batch)
+        s_pal = alp.update_steady_pallas(s_pal, batch, block_r=8, interpret=True)
+        _assert_state_equal(s_ref, s_pal)
+
+
+def test_pallas_multiblock_grid():
+    # R spanning several grid cells (block_r < R).
+    R, k, B = 32, 8, 16
+    state, _ = _fill(jr.key(3), R, k, B)
+    batch = 7_777 + jax.lax.broadcasted_iota(jnp.int32, (R, B), 1)
+    ref = al.update_steady(state, batch)
+    got = alp.update_steady_pallas(state, batch, block_r=8, interpret=True)
+    _assert_state_equal(ref, got)
+
+
+def test_pallas_float32_samples():
+    # Non-int32 element dtype: gather must stay in the batch dtype (values
+    # like 0.5 must survive bit-exactly).
+    R, k, B = 8, 8, 32
+    state = al.init(jr.key(5), R, k, sample_dtype=jnp.float32)
+    mk = lambda lo: lo + 0.5 + jax.lax.broadcasted_iota(jnp.float32, (R, B), 1)
+    state = al.update(state, mk(0.0))
+    ref = al.update_steady(state, mk(1000.0))
+    got = alp.update_steady_pallas(state, mk(1000.0), block_r=8, interpret=True)
+    _assert_state_equal(ref, got)
+
+
+def test_pallas_negative_zero_bit_pattern():
+    # -0.0 elements must survive with their sign bit (the one-hot gather
+    # sums bitcast int32 words, not floats).
+    R, k, B = 8, 8, 64
+    state = al.init(jr.key(6), R, k, sample_dtype=jnp.float32)
+    neg = jnp.full((R, B), -0.0, jnp.float32)
+    state = al.update(state, neg)
+    ref = al.update_steady(state, neg)
+    got = alp.update_steady_pallas(state, neg, block_r=8, interpret=True)
+    np.testing.assert_array_equal(
+        np.asarray(ref.samples).view(np.uint32),
+        np.asarray(got.samples).view(np.uint32),
+    )
+    assert np.signbit(np.asarray(got.samples)).all()
+
+
+def test_pallas_rejects_wrong_row_count():
+    state = al.init(jr.key(7), 16, 4)
+    with pytest.raises(ValueError, match="rows"):
+        alp.update_steady_pallas(state, jnp.zeros((8, 16), jnp.int32), block_r=8)
+
+
+def test_supports_gates():
+    state = al.init(jr.key(4), 8, 4)
+    assert alp.supports(state, None, None, block_r=8)
+    assert not alp.supports(state, jnp.ones((8,), jnp.int32), None, 8)  # ragged
+    assert not alp.supports(state, None, lambda x: x, 8)  # map_fn
+    assert not alp.supports(state, None, None, block_r=3)  # R % block
+    # dtype gates: mismatched batch dtype or unsupported sample dtype
+    assert not alp.supports(state, None, None, 8, jnp.zeros((8, 4), jnp.float32))
+    state64 = al.init(jr.key(5), 8, 4, sample_dtype=jnp.int8)
+    assert not alp.supports(state64, None, None, 8)
+    with pytest.raises(ValueError):
+        alp.update_steady_pallas(state, jnp.zeros((8, 4), jnp.int32), block_r=3)

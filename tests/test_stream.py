@@ -391,3 +391,18 @@ def test_bridge_reusable_snapshots():
     # Sampler.scala:353-381 — structural here)
     assert sorted(int(x) for x in first[0]) == [0, 1, 2]
     assert sorted(int(x) for x in second[0]) == [0, 1, 2, 3, 4, 5]
+
+
+def test_shared_closed_sampler_fails_future_not_deadlock():
+    # A factory that (illegally) hands the same single-use sampler to two
+    # runs: the second run's completion must fail the future loudly instead
+    # of leaving it pending forever (drain() would deadlock).
+    from reservoir_tpu import sampler
+    from reservoir_tpu.errors import SamplerClosedError
+
+    shared = sampler(3, rng=42)
+    flow = Sample.from_factory(lambda: shared)
+    assert flow.run(range(10)).drain() is not None  # first run: fine, closes it
+    run2 = flow.run(iter([]))
+    with pytest.raises(SamplerClosedError):
+        run2.drain()

@@ -1,0 +1,80 @@
+"""Bit-compatibility pin: ops.threefry == jax.random (threefry, partitionable).
+
+This equality is the foundation of the Pallas/vmap bit-equivalence story
+(SURVEY §7.3 "RNG parity"): the Pallas kernel cannot call jax.random, so it
+uses ops.threefry — these tests prove that is the *same* RNG, not a lookalike.
+"""
+
+import jax.numpy as jnp
+import jax.random as jr
+import numpy as np
+import pytest
+
+from reservoir_tpu.ops import threefry as tf
+
+
+def _words(key):
+    d = jr.key_data(key)
+    return d[0], d[1]
+
+
+@pytest.mark.parametrize("seed", [0, 1, 42, 2**31 - 1])
+def test_fold_in_matches_jax(seed):
+    key = jr.key(seed)
+    k1, k2 = _words(key)
+    for idx in [0, 1, 7, 128, 2**20, 2**31 - 5]:
+        expect = jr.key_data(jr.fold_in(key, idx))
+        got = tf.fold_in_words(k1, k2, jnp.uint32(idx))
+        np.testing.assert_array_equal(np.stack(got), np.asarray(expect))
+
+
+@pytest.mark.parametrize("n", [1, 2, 3, 5])
+def test_bits_words_matches_jax(n):
+    key = jr.key(123)
+    expect = jr.bits(key, (n,), jnp.uint32)
+    got = tf.bits_words(*_words(key), n)
+    np.testing.assert_array_equal(np.stack(got), np.asarray(expect))
+
+
+def test_counter_bits_matches_jax_vectorized():
+    key = jr.key(7)
+    k1, k2 = _words(key)
+    idxs = jnp.asarray([1, 2, 1000, 2**30], jnp.uint32)
+    got = tf.counter_bits(k1, k2, idxs, 3)  # 3 arrays of shape [4]
+    for lane, idx in enumerate(np.asarray(idxs)):
+        expect = jr.bits(jr.fold_in(key, int(idx)), (3,), jnp.uint32)
+        np.testing.assert_array_equal(
+            np.asarray([w[lane] for w in got]), np.asarray(expect)
+        )
+
+
+def test_fold_in_64bit_no_wraparound():
+    # Unlike jr.fold_in (which casts to uint32), a 64-bit index folds its
+    # high word in: indices 2^32 apart must NOT repeat draws.
+    import jax
+
+    key = jr.key(9)
+    k1, k2 = _words(key)
+    with jax.enable_x64(True):
+        lo = jnp.asarray(12345, jnp.int64)
+        hi = lo + (jnp.asarray(1, jnp.int64) << 32)
+        a = np.stack(tf.fold_in_words(k1, k2, lo))
+        b = np.stack(tf.fold_in_words(k1, k2, hi))
+        assert not np.array_equal(a, b)
+        # low-word-only (32-bit) path still matches jax exactly
+        expect = jr.key_data(jr.fold_in(key, 12345))
+        np.testing.assert_array_equal(a, np.asarray(expect))
+
+
+def test_threefry_known_vector():
+    # Threefry-2x32 test vector: zero key, zero counter (Random123 / jax
+    # regression value).
+    x0, x1 = tf.threefry2x32(
+        jnp.uint32(0), jnp.uint32(0), jnp.uint32(0), jnp.uint32(0)
+    )
+    import jax._src.prng as _prng
+
+    e0, e1 = _prng.threefry2x32_p.bind(
+        jnp.uint32(0), jnp.uint32(0), jnp.uint32(0), jnp.uint32(0)
+    )
+    assert int(x0) == int(e0) and int(x1) == int(e1)
