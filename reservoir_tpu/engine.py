@@ -64,6 +64,7 @@ class ReservoirEngine:
         map_fn: Optional[Callable] = None,
         hash_fn: Optional[Callable] = None,
         reusable: bool = False,
+        _initial_state: Any = None,
     ) -> None:
         validate_max_sample_size(config.max_sample_size)
         if config.weighted and config.distinct:
@@ -81,15 +82,21 @@ class ReservoirEngine:
             self._ops = _weighted
         else:
             self._ops = _algl
-        if key is None or isinstance(key, int):
-            key = jr.key(0 if key is None else key)
-        self._state = self._ops.init(
-            key,
-            config.num_reservoirs,
-            config.max_sample_size,
-            sample_dtype=jnp.dtype(config.resolved_sample_dtype()),
-            count_dtype=jnp.dtype(config.count_dtype),
-        )
+        if _initial_state is not None:
+            # checkpoint-restore path (utils.checkpoint.load_engine): adopt
+            # the restored pytree instead of paying ops.init for buffers
+            # that would be thrown away
+            self._state = _initial_state
+        else:
+            if key is None or isinstance(key, int):
+                key = jr.key(0 if key is None else key)
+            self._state = self._ops.init(
+                key,
+                config.num_reservoirs,
+                config.max_sample_size,
+                sample_dtype=jnp.dtype(config.resolved_sample_dtype()),
+                count_dtype=jnp.dtype(config.count_dtype),
+            )
         # Host-side lower bound on every reservoir's count — exact when all
         # tiles are full-width, conservative under ragged `valid`.  Decides
         # fill vs steady dispatch with no device readback.
@@ -260,6 +267,29 @@ class ReservoirEngine:
                 self.sample(chunk, np.full((R,), w, np.int32), weights=wchunk)
             else:
                 self.sample(chunk, weights=wchunk)
+
+    # ----------------------------------------------------------- checkpoints
+
+    def save(self, path: str, metadata: Optional[dict] = None) -> None:
+        """Checkpoint state + config to ``path`` (atomic ``.npz``); resume
+        with :meth:`restore` — bit-exact, because draws are keyed on absolute
+        stream indices (SURVEY §5 checkpoint row)."""
+        from .utils.checkpoint import save_engine
+
+        save_engine(path, self, metadata=metadata)
+
+    @classmethod
+    def restore(
+        cls,
+        path: str,
+        map_fn: Optional[Callable] = None,
+        hash_fn: Optional[Callable] = None,
+    ) -> "ReservoirEngine":
+        """Reconstruct a checkpointed engine; ``map_fn``/``hash_fn`` are code
+        and must be re-supplied when the checkpoint was taken with them."""
+        from .utils.checkpoint import load_engine
+
+        return load_engine(path, map_fn=map_fn, hash_fn=hash_fn, engine_cls=cls)
 
     # --------------------------------------------------------------- results
 

@@ -6,7 +6,15 @@ the TPU framework.  Everything here is dependency-light and optional: the
 core sampling path never requires this package.
 """
 
+from .checkpoint import load_engine, load_state, save_engine, save_state
 from .metrics import BridgeMetrics
 from .tracing import trace_span
 
-__all__ = ["BridgeMetrics", "trace_span"]
+__all__ = [
+    "BridgeMetrics",
+    "load_engine",
+    "load_state",
+    "save_engine",
+    "save_state",
+    "trace_span",
+]

@@ -1,0 +1,234 @@
+"""Checkpoint / resume for reservoir state (SURVEY §5 "checkpoint" row).
+
+The reference has no checkpointing; its nearest analog is the reusable
+sampler's copy-on-write snapshot (``Sampler.scala:353-381``) — a mid-stream
+read that doesn't stop sampling.  Here snapshots are first-class: every
+sampler's state is a small pure pytree (state ≪ stream by construction,
+``Sampler.scala:11-12``), so a checkpoint is one ``.npz`` write, and resuming
+is bit-exact — the counter-based RNG (:mod:`reservoir_tpu.ops.rng`) keys every
+draw on the absolute stream index, so "run, checkpoint, restore, continue"
+produces the *same* reservoirs as an uninterrupted run (pinned by
+``tests/test_checkpoint.py``).
+
+Format: a single ``.npz`` holding the state arrays (typed PRNG keys are
+stored as their raw ``key_data`` words plus the impl name) and a JSON
+manifest. Writes are atomic (temp file + ``os.replace``), so a crash during
+checkpointing never corrupts the previous checkpoint — the failure-recovery
+story is "replay from last snapshot" (SURVEY §5 failure-detection row).
+
+Self-contained on purpose: no orbax dependency — reservoir state is a
+handful of ``[R, k]`` arrays, not a model tree, and a dependency-free format
+keeps restore possible from any process (including CPU-only tooling).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import tempfile
+from typing import Any, Callable, Optional, Tuple
+
+import numpy as np
+
+__all__ = ["save_state", "load_state", "save_engine", "load_engine"]
+
+_FORMAT_VERSION = 1
+
+
+def _state_registry():
+    # deferred: keep jax out of module import (mirrors the package's lazy
+    # import policy, reservoir_tpu/__init__.py)
+    from ..ops.algorithm_l import ReservoirState
+    from ..ops.distinct import DistinctState
+    from ..ops.weighted import WeightedState
+
+    return {
+        "ReservoirState": ReservoirState,
+        "DistinctState": DistinctState,
+        "WeightedState": WeightedState,
+    }
+
+
+def _pack_state(state: Any) -> Tuple[dict, dict]:
+    """Split a state NamedTuple into (arrays, manifest-fields)."""
+    import jax
+    import jax.random as jr
+
+    arrays: dict = {}
+    fields = []
+    for name, value in zip(type(state)._fields, state):
+        if jax.dtypes.issubdtype(value.dtype, jax.dtypes.prng_key):
+            arrays[name] = np.asarray(jr.key_data(value))
+            fields.append(
+                {"name": name, "kind": "prng_key", "impl": str(jr.key_impl(value))}
+            )
+        else:
+            arrays[name] = np.asarray(value)
+            fields.append({"name": name, "kind": "array"})
+    return arrays, {"state_class": type(state).__name__, "fields": fields}
+
+
+def _unpack_state(arrays: dict, manifest: dict) -> Any:
+    import jax.numpy as jnp
+    import jax.random as jr
+
+    cls = _state_registry()[manifest["state_class"]]
+    values = []
+    for field in manifest["fields"]:
+        raw = arrays[field["name"]]
+        if field["kind"] == "prng_key":
+            values.append(jr.wrap_key_data(jnp.asarray(raw), impl=field["impl"]))
+        else:
+            restored = jnp.asarray(raw)
+            if restored.dtype != raw.dtype:
+                # e.g. an int64 count array restored in an x64-disabled
+                # process: jnp.asarray would silently narrow it and counts
+                # would wrap — refuse instead of corrupting the resume
+                raise ValueError(
+                    f"checkpoint field {field['name']!r} has dtype "
+                    f"{raw.dtype}, which this process would narrow to "
+                    f"{restored.dtype}; enable jax x64 to restore it"
+                )
+            values.append(restored)
+    return cls(*values)
+
+
+def _atomic_write_npz(path: str, arrays: dict, manifest: dict) -> None:
+    directory = os.path.dirname(os.path.abspath(path)) or "."
+    os.makedirs(directory, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=directory, suffix=".tmp.npz")
+    try:
+        # mkstemp's 0600 would survive the rename; honor the umask like a
+        # plain open() so other tooling can read the checkpoint
+        umask = os.umask(0)
+        os.umask(umask)
+        os.chmod(tmp, 0o666 & ~umask)
+        with os.fdopen(fd, "wb") as fh:
+            np.savez(
+                fh,
+                __manifest__=np.frombuffer(
+                    json.dumps(manifest).encode(), dtype=np.uint8
+                ),
+                **arrays,
+            )
+            # flush file data before the rename: the rename alone is
+            # journaled, the data is not — without this a crash can leave a
+            # truncated file under the final name
+            fh.flush()
+            os.fsync(fh.fileno())
+        os.replace(tmp, path)
+        dir_fd = os.open(directory, os.O_RDONLY)
+        try:
+            os.fsync(dir_fd)
+        finally:
+            os.close(dir_fd)
+    except BaseException:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+        raise
+
+
+def _read_npz(path: str) -> Tuple[dict, dict]:
+    with np.load(path) as data:
+        manifest = json.loads(bytes(data["__manifest__"]).decode())
+        arrays = {k: data[k] for k in data.files if k != "__manifest__"}
+    if manifest.get("format_version") != _FORMAT_VERSION:
+        raise ValueError(
+            f"unsupported checkpoint format {manifest.get('format_version')!r}"
+        )
+    return arrays, manifest
+
+
+def save_state(path: str, state: Any, metadata: Optional[dict] = None) -> None:
+    """Write one state pytree (``ReservoirState`` / ``DistinctState`` /
+    ``WeightedState``) to ``path`` atomically.  ``metadata`` (JSON-able) rides
+    along and comes back from :func:`load_state`."""
+    arrays, manifest = _pack_state(state)
+    manifest["format_version"] = _FORMAT_VERSION
+    manifest["metadata"] = metadata or {}
+    _atomic_write_npz(path, arrays, manifest)
+
+
+def load_state(path: str, with_metadata: bool = False):
+    """Restore a state pytree saved by :func:`save_state`; the returned state
+    resumes sampling bit-exactly (counter-keyed draws carry no hidden host
+    RNG)."""
+    arrays, manifest = _read_npz(path)
+    state = _unpack_state(arrays, manifest)
+    return (state, manifest["metadata"]) if with_metadata else state
+
+
+# ------------------------------------------------------------------ engines
+
+
+def _config_to_jsonable(config) -> dict:
+    import jax.numpy as jnp
+
+    d = dataclasses.asdict(config)
+    for key, value in d.items():
+        if key.endswith("_dtype") and value is not None:
+            d[key] = jnp.dtype(value).name
+    return d
+
+
+def save_engine(path: str, engine, metadata: Optional[dict] = None) -> None:
+    """Checkpoint a live :class:`~reservoir_tpu.engine.ReservoirEngine`:
+    state + config + lifecycle, enough to :func:`load_engine` and continue
+    streaming exactly where it stopped.
+
+    ``map_fn`` / ``hash_fn`` are code, not data — they are recorded only as
+    present/absent and must be re-supplied to :func:`load_engine`.
+    """
+    engine._check_open()
+    arrays, manifest = _pack_state(engine._state)
+    manifest["format_version"] = _FORMAT_VERSION
+    manifest["metadata"] = metadata or {}
+    manifest["engine"] = {
+        "config": _config_to_jsonable(engine.config),
+        "reusable": engine._reusable,
+        "min_count": engine._min_count,
+        "has_map_fn": engine._map_fn is not None,
+        "has_hash_fn": engine._hash_fn is not None,
+    }
+    _atomic_write_npz(path, arrays, manifest)
+
+
+def load_engine(
+    path: str,
+    map_fn: Optional[Callable] = None,
+    hash_fn: Optional[Callable] = None,
+    engine_cls: Optional[type] = None,
+):
+    """Reconstruct a checkpointed engine.  Raises if the checkpoint was taken
+    with a ``map_fn``/``hash_fn`` and none is supplied (or vice versa) — a
+    silent mismatch would quietly change what gets stored.  ``engine_cls``
+    lets ``SubEngine.restore(path)`` come back as the subclass."""
+    from ..config import SamplerConfig
+    from ..engine import ReservoirEngine
+
+    arrays, manifest = _read_npz(path)
+    info = manifest.get("engine")
+    if info is None:
+        raise ValueError(
+            f"{path!r} is a bare state checkpoint; use load_state()"
+        )
+    for flag, fn, name in (
+        ("has_map_fn", map_fn, "map_fn"),
+        ("has_hash_fn", hash_fn, "hash_fn"),
+    ):
+        if info[flag] != (fn is not None):
+            raise ValueError(
+                f"checkpoint was saved with {name} "
+                f"{'present' if info[flag] else 'absent'}; restore must match"
+            )
+    config = SamplerConfig(**info["config"])
+    engine = (engine_cls or ReservoirEngine)(
+        config,
+        map_fn=map_fn,
+        hash_fn=hash_fn,
+        reusable=info["reusable"],
+        _initial_state=_unpack_state(arrays, manifest),
+    )
+    engine._min_count = info["min_count"]
+    return engine

@@ -1,0 +1,165 @@
+"""Checkpoint/resume: save -> restore -> continue == uninterrupted run.
+
+The reference has nothing to compare against (SURVEY §5: checkpointing is
+absent there); the contract tested here is the framework's own: because
+draws are keyed on absolute stream indices, resuming from a checkpoint is
+*bit-exact*, not merely statistically equivalent.
+"""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import jax.random as jr
+import numpy as np
+import pytest
+
+from reservoir_tpu import SamplerConfig
+from reservoir_tpu.engine import ReservoirEngine
+from reservoir_tpu.errors import SamplerClosedError
+from reservoir_tpu.ops import algorithm_l as al
+from reservoir_tpu.utils import load_engine, load_state, save_engine, save_state
+
+
+def _tile(R, B, lo, dtype=np.int32):
+    return lo + np.arange(R * B, dtype=dtype).reshape(R, B)
+
+
+# ------------------------------------------------------------- state-level
+
+
+def test_state_roundtrip_algorithm_l(tmp_path):
+    state = al.init(jr.key(1), 8, 4)
+    state = al.update(state, jnp.asarray(_tile(8, 16, 0)))
+    path = str(tmp_path / "algl.npz")
+    save_state(path, state, metadata={"step": 3})
+    restored, meta = load_state(path, with_metadata=True)
+    assert meta == {"step": 3}
+    for a, b in zip(state, restored):
+        if jax.dtypes.issubdtype(a.dtype, jax.dtypes.prng_key):
+            np.testing.assert_array_equal(
+                np.asarray(jr.key_data(a)), np.asarray(jr.key_data(b))
+            )
+        else:
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # restored state keeps sampling identically
+    nxt_tile = jnp.asarray(_tile(8, 16, 999))
+    for f_orig, f_rest in zip(al.update(state, nxt_tile), al.update(restored, nxt_tile)):
+        if not jax.dtypes.issubdtype(f_orig.dtype, jax.dtypes.prng_key):
+            np.testing.assert_array_equal(np.asarray(f_orig), np.asarray(f_rest))
+
+
+@pytest.mark.parametrize("mode", ["plain", "distinct", "weighted"])
+def test_engine_resume_bit_exact(tmp_path, mode):
+    R, k, B, tiles = 4, 5, 32, 6
+    config = SamplerConfig(
+        max_sample_size=k,
+        num_reservoirs=R,
+        distinct=(mode == "distinct"),
+        weighted=(mode == "weighted"),
+    )
+
+    def feed(engine, start, n):
+        for t in range(start, start + n):
+            tile = _tile(R, B, t * 1000)
+            if mode == "weighted":
+                engine.sample(tile, weights=np.full((R, B), 1.0 + t, np.float32))
+            else:
+                engine.sample(tile)
+
+    # uninterrupted run
+    ref = ReservoirEngine(config, key=7, reusable=True)
+    feed(ref, 0, tiles)
+    ref_samples, ref_sizes = ref.result_arrays()
+
+    # checkpointed run: half, save, restore, half
+    eng = ReservoirEngine(config, key=7, reusable=True)
+    feed(eng, 0, tiles // 2)
+    path = str(tmp_path / f"{mode}.npz")
+    eng.save(path)
+    feed(eng, tiles // 2, tiles - tiles // 2)  # original continues too
+
+    resumed = ReservoirEngine.restore(path)
+    assert resumed.config == config
+    feed(resumed, tiles // 2, tiles - tiles // 2)
+    got_samples, got_sizes = resumed.result_arrays()
+
+    np.testing.assert_array_equal(ref_sizes, got_sizes)
+    np.testing.assert_array_equal(ref_samples, got_samples)
+    # and the never-checkpointed original agrees as well
+    orig_samples, _ = eng.result_arrays()
+    np.testing.assert_array_equal(ref_samples, orig_samples)
+
+
+def test_engine_restore_requires_matching_fns(tmp_path):
+    config = SamplerConfig(max_sample_size=3, num_reservoirs=2)
+    eng = ReservoirEngine(config, key=1, map_fn=lambda x: x * 2, reusable=True)
+    eng.sample(_tile(2, 8, 0))
+    path = str(tmp_path / "fn.npz")
+    eng.save(path)
+    with pytest.raises(ValueError, match="map_fn"):
+        ReservoirEngine.restore(path)
+    restored = ReservoirEngine.restore(path, map_fn=lambda x: x * 2)
+    restored.sample(_tile(2, 8, 99))
+
+
+def test_closed_engine_cannot_save(tmp_path):
+    eng = ReservoirEngine(SamplerConfig(max_sample_size=2, num_reservoirs=2), key=0)
+    eng.sample(_tile(2, 4, 0))
+    eng.result_arrays()  # closes the single-use engine
+    with pytest.raises(SamplerClosedError):
+        eng.save(str(tmp_path / "closed.npz"))
+
+
+def test_atomic_write_leaves_no_tmp(tmp_path):
+    state = al.init(jr.key(0), 2, 2)
+    path = str(tmp_path / "a.npz")
+    save_state(path, state)
+    save_state(path, state)  # overwrite is atomic too
+    assert sorted(os.listdir(tmp_path)) == ["a.npz"]
+
+
+def test_bare_state_checkpoint_rejected_by_load_engine(tmp_path):
+    state = al.init(jr.key(0), 2, 2)
+    path = str(tmp_path / "bare.npz")
+    save_state(path, state)
+    with pytest.raises(ValueError, match="bare state"):
+        load_engine(path)
+    # and engine checkpoints still load as bare states if asked
+    eng = ReservoirEngine(
+        SamplerConfig(max_sample_size=2, num_reservoirs=2), key=0, reusable=True
+    )
+    eng.sample(_tile(2, 4, 0))
+    epath = str(tmp_path / "eng.npz")
+    save_engine(epath, eng)
+    st = load_state(epath)
+    assert st.samples.shape == (2, 2)
+
+
+def test_restore_preserves_subclass(tmp_path):
+    class TaggedEngine(ReservoirEngine):
+        tag = "custom"
+
+    eng = TaggedEngine(
+        SamplerConfig(max_sample_size=2, num_reservoirs=2), key=0, reusable=True
+    )
+    eng.sample(_tile(2, 4, 0))
+    path = str(tmp_path / "sub.npz")
+    eng.save(path)
+    restored = TaggedEngine.restore(path)
+    assert isinstance(restored, TaggedEngine) and restored.tag == "custom"
+
+
+def test_restore_refuses_dtype_narrowing(tmp_path):
+    # int64 counters saved under x64 must not silently narrow to int32 in an
+    # x64-off process.
+    path = str(tmp_path / "x64.npz")
+    with jax.enable_x64(True):
+        state = al.init(jr.key(0), 2, 2, count_dtype=jnp.int64)
+        save_state(path, state)
+    assert not jax.config.jax_enable_x64
+    with pytest.raises(ValueError, match="narrow"):
+        load_state(path)
+    with jax.enable_x64(True):
+        st = load_state(path)  # x64 on: restores fine
+        assert st.count.dtype == jnp.int64
