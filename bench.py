@@ -1,25 +1,35 @@
-"""Headline benchmark: vmapped Algorithm-L throughput on one chip.
+"""Headline benchmark: sustained sampling throughput on one chip.
 
-Measures sustained elements/sec across R concurrent k-reservoirs in steady
-state (BASELINE.md north star: >= 1e9 elem/s across 65,536 k=128 reservoirs
-on one TPU v5e chip).  The stream is device-resident synthetic int32 data —
-the TPU analog of the reference's in-memory 1M-element iterator
-(BASELINE.md config 1); host-feed throughput is benchmarked separately by
-the stream bridge.
+Measures steady-state elements/sec across R concurrent k-reservoirs
+(BASELINE.md north star: >= 1e9 elem/s across 65,536 k=128 reservoirs on one
+TPU v5e chip).  The stream is device-resident synthetic data — the TPU
+analog of the reference's in-memory 1M-element iterator (BASELINE.md
+config 1); host-feed throughput is the stream bridge's own number.
+
+Timing protocol (this matters on tunneled TPU backends, where per-dispatch
+RPC latency is tens of ms and ``block_until_ready`` can return early):
+
+- all timed steps are chained inside ONE jit via ``lax.scan`` with donated
+  state, so the device runs back-to-back with zero dispatch gaps;
+- the wall-clock barrier is a host readback of a scalar from the final
+  state, never ``block_until_ready``.
 
 Prints exactly ONE JSON line:
   {"metric": ..., "value": N, "unit": "elem/s", "vs_baseline": N}
 
 Env knobs:
-  RESERVOIR_BENCH_SMOKE=1   tiny shapes for a CPU smoke run
+  RESERVOIR_BENCH_SMOKE=1       tiny shapes for a CPU smoke run
+  RESERVOIR_BENCH_CONFIG        algl (default) | distinct | weighted
+  RESERVOIR_BENCH_IMPL          xla (default) | pallas   (algl only)
   RESERVOIR_BENCH_PLATFORM=cpu  force the CPU backend (config.update — the
-                            JAX_PLATFORMS env var is claimed by the axon
-                            sitecustomize and must not be overridden)
-  RESERVOIR_BENCH_R/K/B/STEPS  override the config
+                                JAX_PLATFORMS env var belongs to the axon
+                                sitecustomize and must not be overridden)
+  RESERVOIR_BENCH_R/K/B/STEPS   override the shape
 """
 
 from __future__ import annotations
 
+import functools
 import json
 import os
 import sys
@@ -32,51 +42,137 @@ if os.environ.get("RESERVOIR_BENCH_PLATFORM"):
 
 import jax.numpy as jnp
 import jax.random as jr
-
-from reservoir_tpu.ops import algorithm_l as al
+import numpy as np
 
 NORTH_STAR = 1e9  # elem/s (BASELINE.md)
 
 
-def main() -> None:
-    smoke = os.environ.get("RESERVOIR_BENCH_SMOKE") == "1"
-    R = int(os.environ.get("RESERVOIR_BENCH_R", 1024 if smoke else 65536))
-    k = int(os.environ.get("RESERVOIR_BENCH_K", 128))
-    B = int(os.environ.get("RESERVOIR_BENCH_B", 256 if smoke else 2048))
-    steps = int(os.environ.get("RESERVOIR_BENCH_STEPS", 5 if smoke else 50))
+def _readback_barrier(state) -> int:
+    """Honest completion barrier: pull one scalar to the host."""
+    leaf = jax.tree.leaves(state)[0]
+    return int(np.asarray(jax.device_get(leaf.ravel()[0])))
+
+
+def _timed(run, state, step0_warm, step0_timed):
+    """The one timing protocol every config uses: warm (compile) call,
+    barrier, then one timed call bracketed by readback barriers."""
+    state = run(state, jnp.asarray(step0_warm, jnp.int32))
+    _readback_barrier(state)
+    t0 = time.perf_counter()
+    state = run(state, jnp.asarray(step0_timed, jnp.int32))
+    _readback_barrier(state)
+    return time.perf_counter() - t0
+
+
+def _bench_algl(R, k, B, steps, impl):
+    from reservoir_tpu.ops import algorithm_l as al
+
+    if impl == "pallas":
+        from reservoir_tpu.ops import algorithm_l_pallas as alp
+
+        step_fn = functools.partial(
+            alp.update_steady_pallas,
+            block_r=64,
+            # Mosaic compiles on TPU; the CPU backend only has the interpreter
+            interpret=jax.default_backend() == "cpu",
+        )
+    else:
+        step_fn = al.update_steady
+
+    @functools.partial(jax.jit, donate_argnums=0)
+    def run(state, step0):
+        def body(state, s):
+            base = ((step0 + s) * B).astype(jnp.int32)
+            batch = base + jax.lax.broadcasted_iota(jnp.int32, (R, B), 1)
+            return step_fn(state, batch), None
+
+        state, _ = jax.lax.scan(body, state, jnp.arange(steps, dtype=jnp.int32))
+        return state
 
     state = al.init(jr.key(0), R, k)
+    state = al.update(state, jax.lax.broadcasted_iota(jnp.int32, (R, B), 1))
+    while _readback_barrier(state.count) < k:  # fill phase done?
+        state = al.update(
+            state, jax.lax.broadcasted_iota(jnp.int32, (R, B), 1)
+        )
+    return _timed(run, state, 1, 1 + steps)
 
-    @jax.jit
-    def fill_step(state, step):
-        base = (step * (R * B)).astype(jnp.int32)
-        batch = base + jax.lax.broadcasted_iota(jnp.int32, (R, B), 1)
-        return al.update(state, batch)
 
-    @jax.jit
-    def steady_step(state, step):
-        base = (step * (R * B)).astype(jnp.int32)
-        batch = base + jax.lax.broadcasted_iota(jnp.int32, (R, B), 1)
-        return al.update_steady(state, batch)
+def _bench_distinct(R, k, B, steps):
+    from reservoir_tpu.ops import distinct as dd
 
-    # fill phase + warm-up compile of both paths
-    state = fill_step(state, jnp.asarray(0, jnp.int32))
-    while int(state.count[0]) < k:
-        state = fill_step(state, jnp.asarray(1, jnp.int32))
-    state = steady_step(state, jnp.asarray(2, jnp.int32))
-    jax.block_until_ready(state)
+    @functools.partial(jax.jit, donate_argnums=0)
+    def run(state, step0):
+        def body(carry, s):
+            state, key = carry
+            key, sub = jr.split(key)
+            # approximate Zipf-1.1 keys via inverse-CDF of a bounded Pareto:
+            # heavy duplication stresses the dedup path (BASELINE config 3)
+            u = jr.uniform(sub, (R, B), minval=1e-6)
+            batch = jnp.minimum(u ** (-1.0 / 0.1), 1e7).astype(jnp.int32)
+            return (dd.update(state, batch), key), None
 
-    t0 = time.perf_counter()
-    for s in range(steps):
-        state = steady_step(state, jnp.asarray(3 + s, jnp.int32))
-    jax.block_until_ready(state)
-    dt = time.perf_counter() - t0
+        (state, _), _ = jax.lax.scan(
+            body, (state, jr.fold_in(jr.key(99), step0)),
+            jnp.arange(steps, dtype=jnp.int32),
+        )
+        return state
+
+    state = dd.init(jr.key(0), R, k)
+    return _timed(run, state, 0, 1)
+
+
+def _bench_weighted(R, k, B, steps):
+    from reservoir_tpu.ops import weighted as ww
+
+    @functools.partial(jax.jit, donate_argnums=0)
+    def run(state, step0):
+        def body(state, s):
+            base = ((step0 + s) * B).astype(jnp.int32)
+            batch = base + jax.lax.broadcasted_iota(jnp.int32, (R, B), 1)
+            weights = 1.0 + 0.5 * jnp.cos(batch.astype(jnp.float32) * 1e-3) ** 2
+            return ww.update(state, batch, weights), None
+
+        state, _ = jax.lax.scan(body, state, jnp.arange(steps, dtype=jnp.int32))
+        return state
+
+    state = ww.init(jr.key(0), R, k)
+    return _timed(run, state, 0, 1)
+
+
+def main() -> None:
+    smoke = os.environ.get("RESERVOIR_BENCH_SMOKE") == "1"
+    config = os.environ.get("RESERVOIR_BENCH_CONFIG", "algl")
+    impl = os.environ.get("RESERVOIR_BENCH_IMPL", "xla")
+    if config not in ("algl", "distinct", "weighted"):
+        raise SystemExit(f"RESERVOIR_BENCH_CONFIG must be algl|distinct|weighted, got {config!r}")
+    if impl not in ("xla", "pallas"):
+        raise SystemExit(f"RESERVOIR_BENCH_IMPL must be xla|pallas, got {impl!r}")
+    defaults = {
+        "algl": (1024 if smoke else 65536, 128, 256 if smoke else 2048),
+        "distinct": (256 if smoke else 4096, 32 if smoke else 256, 1024),
+        "weighted": (512 if smoke else 16384, 64, 1024),
+    }[config]
+    R = int(os.environ.get("RESERVOIR_BENCH_R", defaults[0]))
+    k = int(os.environ.get("RESERVOIR_BENCH_K", defaults[1]))
+    B = int(os.environ.get("RESERVOIR_BENCH_B", defaults[2]))
+    steps = int(os.environ.get("RESERVOIR_BENCH_STEPS", 5 if smoke else 50))
+
+    if config == "algl":
+        dt = _bench_algl(R, k, B, steps, impl)
+        tag = f"algl_{impl}"
+    elif config == "distinct":
+        dt = _bench_distinct(R, k, B, steps)
+        tag = "distinct"
+    else:
+        dt = _bench_weighted(R, k, B, steps)
+        tag = "weighted"
 
     value = R * B * steps / dt
     print(
         json.dumps(
             {
-                "metric": f"algl_steady_elements_per_sec_R{R}_k{k}_B{B}",
+                "metric": f"{tag}_steady_elements_per_sec_R{R}_k{k}_B{B}",
                 "value": value,
                 "unit": "elem/s",
                 "vs_baseline": value / NORTH_STAR,

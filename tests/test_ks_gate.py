@@ -1,0 +1,111 @@
+"""KS-distance acceptance gate (BASELINE.md north star: within 1% of the CPU
+Sampler).
+
+The reference gates statistical quality with 5-sigma frequency tests
+(``SamplerTest.scala:144-240``); the driver's metric for this framework is a
+Kolmogorov-Smirnov distance against the CPU oracle.  Both views are covered:
+
+- device kernel vs the *exact* uniform law (one-sample KS on the pooled
+  sampled values of many reservoirs over an ordered stream), and
+- device kernel vs the CPU ``AlgorithmLOracle`` (two-sample KS on pooled
+  samples — the literal BASELINE metric).
+
+Pooled KS across R reservoirs is valid because each reservoir's marginal is
+uniform over the stream; within-reservoir without-replacement dependence
+only tightens concentration.  Thresholds sit ~2x above the null-hypothesis
+scale for the sample sizes used, so the gate fails on real bias, not noise.
+"""
+
+import jax
+import jax.numpy as jnp
+import jax.random as jr
+import numpy as np
+
+from reservoir_tpu.oracle.algorithm_l import AlgorithmLOracle
+from reservoir_tpu.ops import algorithm_l as al
+
+GATE = 0.01  # the BASELINE "within 1% KS-distance" gate
+
+
+def _ks_one_sample_uniform(values: np.ndarray, n: int) -> float:
+    """sup_x |ECDF(x) - x/n| for values drawn from {0..n-1}."""
+    s = np.sort(values) / float(n)
+    m = len(s)
+    ecdf_hi = np.arange(1, m + 1) / m
+    ecdf_lo = np.arange(0, m) / m
+    return float(np.maximum(np.abs(ecdf_hi - s), np.abs(s - ecdf_lo)).max())
+
+
+def _ks_two_sample(a: np.ndarray, b: np.ndarray) -> float:
+    allv = np.concatenate([a, b])
+    allv.sort(kind="mergesort")
+    cdf_a = np.searchsorted(np.sort(a), allv, side="right") / len(a)
+    cdf_b = np.searchsorted(np.sort(b), allv, side="right") / len(b)
+    return float(np.abs(cdf_a - cdf_b).max())
+
+
+def _device_samples(key, R, k, n, B=512) -> np.ndarray:
+    state = al.init(key, R, k)
+    fn = jax.jit(al.update, donate_argnums=0)
+    for start in range(0, n, B):
+        batch = start + jax.lax.broadcasted_iota(jnp.int32, (R, B), 1)
+        state = fn(state, batch)
+    samples, sizes = al.result(state)
+    assert int(sizes.min()) == k
+    return np.asarray(samples).ravel()
+
+
+def test_device_within_1pct_ks_of_uniform():
+    # Pool N = R*k = 131,072 draws: null 95th pct ≈ 1.36/sqrt(N) ≈ 0.0038,
+    # so the literal 1% BASELINE gate sits ~2.7x above the null scale —
+    # P(false fail) ≈ 2·exp(-2·N·0.01²) ≈ 1e-11.
+    R, k, n = 2048, 64, 8192
+    values = _device_samples(jr.key(0), R, k, n)
+    ks = _ks_one_sample_uniform(values, n)
+    assert ks < GATE, f"device KS vs uniform = {ks:.4f}"
+
+
+def test_device_within_1pct_ks_of_cpu_oracle():
+    # The literal BASELINE.md metric: device sampler vs CPU Sampler oracle.
+    # Larger pools tighten both ECDFs; the DIFFERENCE of two null KS
+    # statistics concentrates near zero, gated at the driver's 1%.
+    # m = n = R*k = 131,072 per side: effective N = 65,536, null 95th pct
+    # ≈ 1.36*sqrt(2/(R*k)) ≈ 0.0053 — the literal 1% gate has
+    # P(false fail) ≈ 2·exp(-2·65536·0.01²) ≈ 4e-6.
+    R, k, n = 2048, 64, 8192
+    dev = _device_samples(jr.key(1), R, k, n)
+
+    rng = np.random.default_rng(7)
+    cpu = []
+    for _ in range(R):
+        o = AlgorithmLOracle(k, rng)
+        o.sample_all(range(n))
+        cpu.append(o.result())
+    cpu = np.concatenate(cpu).astype(np.int64)
+
+    assert len(dev) == len(cpu) == R * k
+    ks = _ks_two_sample(dev.astype(np.int64), cpu)
+    assert ks < GATE, f"device-vs-oracle KS = {ks:.4f}"
+
+
+def test_distinct_mode_ks_uniform_over_distinct_values():
+    # Distinct mode: inclusion probability uniform over distinct values
+    # (SURVEY §2.2 invariant 6) — pooled sampled values of a 2x-repeated
+    # stream must still be KS-close to uniform over the value domain.
+    from reservoir_tpu.ops import distinct as dd
+
+    # Pool N = R*k = 65,536: the 1% gate is ~2.7x the null 95th pct
+    # (≈ 0.0053); P(false fail) ≈ 4e-6.
+    R, k, n = 2048, 32, 2048
+    state = dd.init(jr.key(2), R, k)
+    fn = jax.jit(dd.update, donate_argnums=0)
+    B = 256
+    for rep in range(2):  # every value appears twice
+        for start in range(0, n, B):
+            batch = start + jax.lax.broadcasted_iota(jnp.int32, (R, B), 1)
+            state = fn(state, batch)
+    samples, sizes = dd.result(state)
+    assert int(np.asarray(sizes).min()) == k
+    values = np.asarray(samples).ravel()
+    ks = _ks_one_sample_uniform(values, n)
+    assert ks < GATE, f"distinct KS vs uniform = {ks:.4f}"
