@@ -1,0 +1,188 @@
+// Host-side staging buffer for the device stream bridge.
+//
+// The reference's stream stage touches one element per actor callback
+// (SampleImpl.scala:27-31, single-threaded per stage); feeding a TPU takes
+// tile-granular flushes instead, and the expensive host-side step is the
+// *demux*: an interleaved feed of (stream_id, element) pairs must be
+// scattered into per-stream rows of the [S, B] staging tile.  In Python
+// that is an interpreter-speed loop; here it is a tight pointer walk.
+//
+// Concurrency contract: one staging buffer is single-producer/
+// single-consumer — push_* and drain may run on different threads (ctypes
+// releases the GIL during calls), guarded by a mutex.  Multiple producers
+// need their own serialization, matching the sampler thread-safety contract
+// of the reference (Sampler.scala:19).
+//
+// Exposed as a plain C ABI for ctypes (no pybind11 in this environment).
+
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <new>
+
+namespace {
+
+struct StagingBuffer {
+  int32_t num_streams;
+  int32_t tile_width;
+  int32_t elem_size;   // bytes per element
+  int32_t value_arrays;  // 1 (elements only) or 2 (elements + weights)
+  uint8_t* data;       // [value_arrays][S][B][elem_size]
+  int32_t* fill;       // [S]
+  std::mutex mu;
+
+  uint8_t* row(int arr, int32_t s) {
+    return data +
+           (static_cast<size_t>(arr) * num_streams + s) *
+               static_cast<size_t>(tile_width) * elem_size;
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+// Create a buffer for S streams x B elements of elem_size bytes each.
+// value_arrays=2 keeps a parallel tile (e.g. weights) routed identically.
+void* rsv_staging_create(int32_t num_streams, int32_t tile_width,
+                         int32_t elem_size, int32_t value_arrays) {
+  if (num_streams <= 0 || tile_width <= 0 || elem_size <= 0 ||
+      value_arrays < 1 || value_arrays > 2) {
+    return nullptr;
+  }
+  auto* sb = new (std::nothrow) StagingBuffer;
+  if (!sb) return nullptr;
+  sb->num_streams = num_streams;
+  sb->tile_width = tile_width;
+  sb->elem_size = elem_size;
+  sb->value_arrays = value_arrays;
+  size_t bytes = static_cast<size_t>(value_arrays) * num_streams *
+                 tile_width * elem_size;
+  // value-initialized: drained rows include never-written slots (whole-row
+  // memcpy), and downstream float consumers must never see heap garbage
+  // (NaN weight bits would defeat the bridge's positivity clamp)
+  sb->data = new (std::nothrow) uint8_t[bytes]();
+  sb->fill = new (std::nothrow) int32_t[num_streams]();
+  if (!sb->data || !sb->fill) {
+    delete[] sb->data;
+    delete[] sb->fill;
+    delete sb;
+    return nullptr;
+  }
+  return sb;
+}
+
+void rsv_staging_destroy(void* handle) {
+  auto* sb = static_cast<StagingBuffer*>(handle);
+  if (!sb) return;
+  delete[] sb->data;
+  delete[] sb->fill;
+  delete sb;
+}
+
+// Append a contiguous chunk to one stream's row.  Returns the number of
+// elements consumed (< n iff the row filled mid-chunk; caller drains and
+// retries from the returned offset).
+int64_t rsv_staging_push_chunk(void* handle, int32_t stream,
+                               const void* elems, const void* weights,
+                               int64_t n) {
+  auto* sb = static_cast<StagingBuffer*>(handle);
+  if (!sb || stream < 0 || stream >= sb->num_streams || n < 0) return -1;
+  if ((sb->value_arrays == 2) != (weights != nullptr)) return -1;
+  std::lock_guard<std::mutex> lock(sb->mu);
+  int32_t fill = sb->fill[stream];
+  int64_t take = sb->tile_width - fill;
+  if (take > n) take = n;
+  if (take > 0) {
+    std::memcpy(sb->row(0, stream) + static_cast<size_t>(fill) * sb->elem_size,
+                elems, static_cast<size_t>(take) * sb->elem_size);
+    if (weights) {
+      std::memcpy(
+          sb->row(1, stream) + static_cast<size_t>(fill) * sb->elem_size,
+          weights, static_cast<size_t>(take) * sb->elem_size);
+    }
+    sb->fill[stream] = fill + static_cast<int32_t>(take);
+  }
+  return take;
+}
+
+// Demux interleaved (stream_id, element[, weight]) pairs into the staging
+// rows — the hot call.  Returns pairs consumed; < n iff some row filled
+// (caller drains, then resumes from the offset).  A bad stream id stops
+// consumption at that pair and returns the count before it (callers detect
+// it by checking streams[consumed] themselves; -1 signals invalid args).
+int64_t rsv_staging_push_interleaved(void* handle, const int32_t* streams,
+                                     const void* elems, const void* weights,
+                                     int64_t n) {
+  auto* sb = static_cast<StagingBuffer*>(handle);
+  if (!sb || !streams || !elems || n < 0) return -1;
+  if ((sb->value_arrays == 2) != (weights != nullptr)) return -1;
+  std::lock_guard<std::mutex> lock(sb->mu);
+  const auto* esrc = static_cast<const uint8_t*>(elems);
+  const auto* wsrc = static_cast<const uint8_t*>(weights);
+  const int32_t esize = sb->elem_size;
+  const int32_t width = sb->tile_width;
+  int64_t i = 0;
+  for (; i < n; ++i) {
+    int32_t s = streams[i];
+    if (s < 0 || s >= sb->num_streams) break;
+    int32_t fill = sb->fill[s];
+    if (fill >= width) break;  // row full: hand control back for a drain
+    std::memcpy(sb->row(0, s) + static_cast<size_t>(fill) * esize,
+                esrc + static_cast<size_t>(i) * esize, esize);
+    if (wsrc) {
+      std::memcpy(sb->row(1, s) + static_cast<size_t>(fill) * esize,
+                  wsrc + static_cast<size_t>(i) * esize, esize);
+    }
+    sb->fill[s] = fill + 1;
+  }
+  return i;
+}
+
+// Current fill of one row — O(1) flush-due check for single-stream pushes.
+int32_t rsv_staging_fill(void* handle, int32_t stream) {
+  auto* sb = static_cast<StagingBuffer*>(handle);
+  if (!sb || stream < 0 || stream >= sb->num_streams) return -1;
+  std::lock_guard<std::mutex> lock(sb->mu);
+  return sb->fill[stream];
+}
+
+// True iff any row is at tile width (a flush is due).
+int32_t rsv_staging_any_full(void* handle) {
+  auto* sb = static_cast<StagingBuffer*>(handle);
+  if (!sb) return 0;
+  std::lock_guard<std::mutex> lock(sb->mu);
+  for (int32_t s = 0; s < sb->num_streams; ++s) {
+    if (sb->fill[s] >= sb->tile_width) return 1;
+  }
+  return 0;
+}
+
+// Copy the staged tile(s) + per-row fill counts out and reset the buffer.
+// out_tile is [S][B][elem_size]; out_weights may be null when
+// value_arrays == 1.  Returns the total staged element count.
+int64_t rsv_staging_drain(void* handle, void* out_tile, void* out_weights,
+                          int32_t* out_valid) {
+  auto* sb = static_cast<StagingBuffer*>(handle);
+  if (!sb || !out_tile || !out_valid) return -1;
+  if ((sb->value_arrays == 2) != (out_weights != nullptr)) return -1;
+  std::lock_guard<std::mutex> lock(sb->mu);
+  size_t row_bytes = static_cast<size_t>(sb->tile_width) * sb->elem_size;
+  int64_t total = 0;
+  for (int32_t s = 0; s < sb->num_streams; ++s) {
+    int32_t fill = sb->fill[s];
+    // copy whole rows: the valid mask excludes stale bytes downstream
+    std::memcpy(static_cast<uint8_t*>(out_tile) + s * row_bytes,
+                sb->row(0, s), row_bytes);
+    if (out_weights) {
+      std::memcpy(static_cast<uint8_t*>(out_weights) + s * row_bytes,
+                  sb->row(1, s), row_bytes);
+    }
+    out_valid[s] = fill;
+    total += fill;
+    sb->fill[s] = 0;
+  }
+  return total;
+}
+
+}  // extern "C"

@@ -1,0 +1,267 @@
+"""ctypes loader for the native host helpers (``native/staging_buffer.cc``).
+
+The runtime around the TPU compute path is native where it matters: the
+stream bridge's interleaved demux — scattering (stream_id, element) pairs
+into per-stream staging rows — is an interpreter-speed loop in Python and a
+pointer walk in C++ (SURVEY §7.3: the host feed, not the kernel, is the
+likely bottleneck at 1e9 elem/s).
+
+Loading is best-effort with a silent build attempt (``make`` in ``native/``)
+and a pure-numpy fallback: the framework never *requires* the .so — it only
+gets faster with it.  ``NativeStaging.available()`` reports which path is in
+use; ``RESERVOIR_TPU_NO_NATIVE=1`` forces the fallback (used by tests to
+cover both).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import Optional
+
+import numpy as np
+
+__all__ = ["NativeStaging", "load_library"]
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_NATIVE_DIR = os.path.join(_REPO_ROOT, "native")
+_SO_PATH = os.path.join(_NATIVE_DIR, "libreservoir_host.so")
+
+_lib: Optional[ctypes.CDLL] = None
+_load_attempted = False
+
+
+def load_library(rebuild: bool = False) -> Optional[ctypes.CDLL]:
+    """Load (building on first use if needed) the native library; None if
+    unavailable — callers fall back to numpy."""
+    global _lib, _load_attempted
+    if os.environ.get("RESERVOIR_TPU_NO_NATIVE") == "1":
+        return None
+    if _lib is not None and not rebuild:
+        return _lib
+    if _load_attempted and not rebuild:
+        return _lib
+    _load_attempted = True
+    if not os.path.exists(_SO_PATH) or rebuild:
+        try:
+            subprocess.run(
+                ["make", "-C", _NATIVE_DIR],
+                check=True,
+                capture_output=True,
+                timeout=120,
+            )
+        except (OSError, subprocess.SubprocessError):
+            return None
+    try:
+        lib = ctypes.CDLL(_SO_PATH)
+    except OSError:
+        return None
+    lib.rsv_staging_create.restype = ctypes.c_void_p
+    lib.rsv_staging_create.argtypes = [ctypes.c_int32] * 4
+    lib.rsv_staging_destroy.argtypes = [ctypes.c_void_p]
+    lib.rsv_staging_push_chunk.restype = ctypes.c_int64
+    lib.rsv_staging_push_chunk.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_int32,
+        ctypes.c_void_p,
+        ctypes.c_void_p,
+        ctypes.c_int64,
+    ]
+    lib.rsv_staging_push_interleaved.restype = ctypes.c_int64
+    lib.rsv_staging_push_interleaved.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_void_p,
+        ctypes.c_void_p,
+        ctypes.c_void_p,
+        ctypes.c_int64,
+    ]
+    lib.rsv_staging_any_full.restype = ctypes.c_int32
+    lib.rsv_staging_any_full.argtypes = [ctypes.c_void_p]
+    lib.rsv_staging_fill.restype = ctypes.c_int32
+    lib.rsv_staging_fill.argtypes = [ctypes.c_void_p, ctypes.c_int32]
+    lib.rsv_staging_drain.restype = ctypes.c_int64
+    lib.rsv_staging_drain.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_void_p,
+        ctypes.c_void_p,
+        ctypes.c_void_p,
+    ]
+    _lib = lib
+    return _lib
+
+
+class NativeStaging:
+    """[S, B] staging tile with C-speed interleaved demux and a numpy
+    fallback.  Single-producer/single-consumer (the bridge's contract)."""
+
+    def __init__(self, num_streams: int, tile_width: int, dtype,
+                 weighted: bool = False) -> None:
+        self._S = int(num_streams)
+        self._B = int(tile_width)
+        self._dtype = np.dtype(dtype)
+        self._weighted = weighted
+        if weighted and self._dtype.itemsize != 4:
+            raise ValueError("weighted staging requires a 4-byte element dtype")
+        self._lib = load_library()
+        if self._lib is not None:
+            self._handle = self._lib.rsv_staging_create(
+                self._S, self._B, self._dtype.itemsize, 2 if weighted else 1
+            )
+            if not self._handle:
+                self._lib = None
+        if self._lib is None:
+            self._handle = None
+            self._buf = np.zeros((self._S, self._B), self._dtype)
+            self._wbuf = np.zeros((self._S, self._B), np.float32) if weighted else None
+            self._fill = np.zeros(self._S, np.int32)
+
+    def available(self) -> bool:
+        """True when the C++ path is live (False: numpy fallback)."""
+        return self._lib is not None
+
+    # ------------------------------------------------------------------ push
+
+    def push_chunk(self, stream: int, elems: np.ndarray,
+                   weights: Optional[np.ndarray] = None) -> int:
+        """Append a contiguous chunk to one row; returns elements consumed
+        (less than ``len(elems)`` when the row filled — drain and resume)."""
+        elems = np.ascontiguousarray(elems, self._dtype)
+        if self._weighted != (weights is not None):
+            raise ValueError("weights required iff staging is weighted")
+        if weights is not None:
+            weights = np.ascontiguousarray(weights, np.float32)
+            if weights.shape != elems.shape:
+                raise ValueError("weights must match elements shape")
+        if self._lib is not None:
+            took = self._lib.rsv_staging_push_chunk(
+                self._handle,
+                int(stream),
+                elems.ctypes.data_as(ctypes.c_void_p),
+                weights.ctypes.data_as(ctypes.c_void_p) if weights is not None else None,
+                elems.size,
+            )
+            if took < 0:
+                raise ValueError("invalid push_chunk arguments")
+            return int(took)
+        fill = int(self._fill[stream])
+        take = min(self._B - fill, elems.size)
+        self._buf[stream, fill : fill + take] = elems[:take]
+        if weights is not None:
+            self._wbuf[stream, fill : fill + take] = weights[:take]
+        self._fill[stream] += take
+        return take
+
+    def push_interleaved(self, streams: np.ndarray, elems: np.ndarray,
+                         weights: Optional[np.ndarray] = None) -> int:
+        """Demux (stream_id, element) pairs; returns pairs consumed (less
+        than ``len(streams)`` when a target row filled mid-batch).  Raises on
+        out-of-range stream ids."""
+        streams = np.ascontiguousarray(streams, np.int32)
+        elems = np.ascontiguousarray(elems, self._dtype)
+        if streams.shape != elems.shape or streams.ndim != 1:
+            raise ValueError("streams and elems must be equal-length 1-D")
+        if self._weighted != (weights is not None):
+            raise ValueError("weights required iff staging is weighted")
+        if weights is not None:
+            weights = np.ascontiguousarray(weights, np.float32)
+            if weights.shape != elems.shape:
+                raise ValueError("weights must match elements shape")
+        if streams.size and (
+            int(streams.min()) < 0 or int(streams.max()) >= self._S
+        ):
+            raise ValueError("stream id out of range")
+        if self._lib is not None:
+            took = self._lib.rsv_staging_push_interleaved(
+                self._handle,
+                streams.ctypes.data_as(ctypes.c_void_p),
+                elems.ctypes.data_as(ctypes.c_void_p),
+                weights.ctypes.data_as(ctypes.c_void_p) if weights is not None else None,
+                streams.size,
+            )
+            if took < 0:
+                raise ValueError("invalid push_interleaved arguments")
+            return int(took)
+        # numpy fallback: stable-sort by stream, then per-present-stream
+        # bulk copies (capacity-limited; stop at the first full row to match
+        # the native consume-prefix contract)
+        n = streams.size
+        i = 0
+        while i < n:
+            s = int(streams[i])
+            fill = int(self._fill[s])
+            if fill >= self._B:
+                break
+            j = i
+            while j < n and int(streams[j]) == s and fill + (j - i) < self._B:
+                j += 1
+            take = j - i
+            self._buf[s, fill : fill + take] = elems[i:j]
+            if weights is not None:
+                self._wbuf[s, fill : fill + take] = weights[i:j]
+            self._fill[s] += take
+            i = j
+        return i
+
+    # ----------------------------------------------------------------- drain
+
+    def any_full(self) -> bool:
+        if self._lib is not None:
+            return bool(self._lib.rsv_staging_any_full(self._handle))
+        return bool(np.any(self._fill >= self._B))
+
+    def row_full(self, stream: int) -> bool:
+        """O(1) flush-due check for one row (the single-stream push path —
+        ``any_full`` is an O(S) scan)."""
+        if self._lib is not None:
+            return self._lib.rsv_staging_fill(self._handle, int(stream)) >= self._B
+        return int(self._fill[stream]) >= self._B
+
+    def drain(self, out_tile: np.ndarray, out_valid: np.ndarray,
+              out_weights: Optional[np.ndarray] = None) -> int:
+        """Copy staged rows + fill counts into caller buffers and reset;
+        returns total staged elements."""
+        # explicit raises, not asserts: these guard raw C memcpys and must
+        # survive python -O
+        if out_tile.shape != (self._S, self._B) or out_tile.dtype != self._dtype:
+            raise ValueError(
+                f"out_tile must be [{self._S}, {self._B}] {self._dtype}"
+            )
+        if out_valid.shape != (self._S,) or out_valid.dtype != np.int32:
+            raise ValueError(f"out_valid must be [{self._S}] int32")
+        if not (out_tile.flags["C_CONTIGUOUS"] and out_valid.flags["C_CONTIGUOUS"]):
+            raise ValueError("drain buffers must be C-contiguous")
+        if out_weights is not None and not (
+            out_weights.flags["C_CONTIGUOUS"]
+            and out_weights.shape == (self._S, self._B)
+            and out_weights.dtype == np.float32
+        ):
+            raise ValueError(
+                f"out_weights must be C-contiguous [{self._S}, {self._B}] float32"
+            )
+        if self._weighted != (out_weights is not None):
+            raise ValueError("out_weights required iff staging is weighted")
+        if self._lib is not None:
+            total = self._lib.rsv_staging_drain(
+                self._handle,
+                out_tile.ctypes.data_as(ctypes.c_void_p),
+                out_weights.ctypes.data_as(ctypes.c_void_p)
+                if out_weights is not None
+                else None,
+                out_valid.ctypes.data_as(ctypes.c_void_p),
+            )
+            if total < 0:
+                raise ValueError("invalid drain arguments")
+            return int(total)
+        out_tile[...] = self._buf
+        if out_weights is not None:
+            out_weights[...] = self._wbuf
+        out_valid[...] = self._fill
+        total = int(self._fill.sum())
+        self._fill[:] = 0
+        return total
+
+    def __del__(self) -> None:
+        lib, handle = getattr(self, "_lib", None), getattr(self, "_handle", None)
+        if lib is not None and handle:
+            lib.rsv_staging_destroy(handle)

@@ -30,6 +30,7 @@ import numpy as np
 from ..config import SamplerConfig
 from ..engine import ReservoirEngine
 from ..errors import AbruptStreamTermination, SamplerClosedError
+from ..native import NativeStaging
 from ..utils.metrics import BridgeMetrics
 from ..utils.tracing import trace_span
 
@@ -67,9 +68,14 @@ class DeviceStreamBridge:
         )
         self._reusable = reusable
         S, B = config.num_reservoirs, config.tile_size
-        self._buf = np.zeros((S, B), dtype=np.dtype(config.element_dtype))
-        self._wbuf = np.ones((S, B), np.float32) if config.weighted else None
-        self._fill = np.zeros(S, np.int64)
+        # staging is native (C++ demux, native/staging_buffer.cc) when the
+        # helper library is available, numpy otherwise — same semantics
+        self._staging = NativeStaging(
+            S, B, np.dtype(config.element_dtype), weighted=config.weighted
+        )
+        self._tile = np.zeros((S, B), dtype=np.dtype(config.element_dtype))
+        self._wtile = np.ones((S, B), np.float32) if config.weighted else None
+        self._valid = np.zeros(S, np.int32)
         self._future: Future = Future()
         self._metrics = BridgeMetrics()
 
@@ -110,31 +116,60 @@ class DeviceStreamBridge:
         flushes automatically whenever the stream's row fills."""
         self._check_open()
         self._metrics.start()
-        arr = np.atleast_1d(np.asarray(elements, self._buf.dtype))
-        if self._wbuf is not None:
+        arr = np.atleast_1d(np.asarray(elements, self._tile.dtype))
+        warr = self._check_weights(arr, weights)
+        off = 0
+        n = arr.shape[0]
+        while off < n:
+            took = self._staging.push_chunk(
+                stream,
+                arr[off:],
+                warr[off:] if warr is not None else None,
+            )
+            off += took
+            if off < n or self._staging.row_full(stream):
+                self.flush()
+        self._metrics.elements += n
+
+    def push_interleaved(self, streams: Any, elements: Any,
+                         weights: Optional[Any] = None) -> None:
+        """Demux an interleaved feed of ``(stream_id, element)`` pairs — the
+        multi-producer wire format.  The scatter runs in the native staging
+        helper when available (C-speed pointer walk; numpy fallback
+        otherwise), flushing whenever a row fills mid-batch."""
+        self._check_open()
+        self._metrics.start()
+        # conversions up front so the resume-loop slices stay no-copy; shape
+        # and range validation belongs to NativeStaging (single owner)
+        streams = np.ascontiguousarray(streams, np.int32)
+        arr = np.ascontiguousarray(elements, self._tile.dtype)
+        warr = self._check_weights(arr, weights)
+        off = 0
+        n = arr.shape[0]
+        while off < n:
+            took = self._staging.push_interleaved(
+                streams[off:],
+                arr[off:],
+                warr[off:] if warr is not None else None,
+            )
+            off += took
+            if off < n:
+                self.flush()
+        self._metrics.elements += n
+
+    def _check_weights(self, arr, weights):
+        if self._wtile is not None:
             if weights is None:
                 raise ValueError("weighted bridge requires weights")
-            warr = np.atleast_1d(np.asarray(weights, np.float32))
+            warr = np.atleast_1d(np.ascontiguousarray(weights, np.float32))
             if warr.shape != arr.shape:
                 raise ValueError("weights must match elements shape")
             if not np.all(warr > 0):
                 raise ValueError("weights must be strictly positive")
-        elif weights is not None:
+            return warr
+        if weights is not None:
             raise ValueError("weights are only meaningful with weighted=True")
-        B = self._buf.shape[1]
-        off = 0
-        n = arr.shape[0]
-        while off < n:
-            fill = int(self._fill[stream])
-            take = min(B - fill, n - off)
-            self._buf[stream, fill : fill + take] = arr[off : off + take]
-            if self._wbuf is not None:
-                self._wbuf[stream, fill : fill + take] = warr[off : off + take]
-            self._fill[stream] += take
-            off += take
-            if self._fill[stream] >= B:
-                self.flush()
-        self._metrics.elements += n
+        return None
 
     def push_tile(self, tile: Any, valid: Optional[Any] = None,
                   weights: Optional[Any] = None) -> None:
@@ -154,17 +189,26 @@ class DeviceStreamBridge:
 
     def flush(self) -> None:
         """Dispatch buffered elements (ragged tile) to the device."""
-        if not np.any(self._fill):
+        total = self._staging.drain(
+            self._tile,
+            self._valid,
+            self._wtile if self._wtile is not None else None,
+        )
+        if total == 0:
             return
-        valid = self._fill.astype(np.int32)
         with trace_span("reservoir_bridge_flush"):
-            if self._wbuf is not None:
-                self._engine.sample(self._buf, valid=valid, weights=self._wbuf)
+            if self._wtile is not None:
+                # stale weight-slots past valid may hold old values; the
+                # valid mask keeps them out of sampling, but the engine's
+                # host-side positivity check must still pass
+                np.maximum(self._wtile, 1e-30, out=self._wtile)
+                self._engine.sample(
+                    self._tile, valid=self._valid, weights=self._wtile
+                )
             else:
-                self._engine.sample(self._buf, valid=valid)
+                self._engine.sample(self._tile, valid=self._valid)
         self._metrics.flushes += 1
-        self._metrics.flushed_elements += int(valid.sum())
-        self._fill[:] = 0
+        self._metrics.flushed_elements += total
 
     # ------------------------------------------------------------ completion
 
