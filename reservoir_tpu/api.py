@@ -41,7 +41,7 @@ from .errors import SamplerClosedError
 from .oracle.algorithm_l import AlgorithmLOracle
 from .oracle.bottom_k import BottomKOracle
 
-__all__ = ["Sampler", "sampler", "distinct"]
+__all__ = ["Sampler", "sampler", "distinct", "weighted", "WeightedSampler"]
 
 _identity = lambda x: x  # noqa: E731
 
@@ -199,3 +199,62 @@ def distinct(
         salts=salts,
     )
     return _ReusableSampler(engine) if reusable else _SingleUseSampler(engine)
+
+
+class WeightedSampler:
+    """Host weighted sampler (A-ExpJ) behind the reference lifecycle.
+
+    Capability beyond the reference (it has no weighted mode — SURVEY §6);
+    the surface mirrors :class:`Sampler` except ``sample`` takes
+    ``(element, weight)``.  Zero-weight contract: ``w == 0`` is counted but
+    never sampled; ``w < 0`` raises — identical to the device engine
+    (:mod:`reservoir_tpu.ops.weighted` module docs).
+    """
+
+    def __init__(self, engine, reusable: bool) -> None:
+        self._engine = engine
+        self._reusable = reusable
+        self._open = True
+
+    def _check_open(self) -> None:
+        if not self._reusable and not self._open:
+            raise SamplerClosedError(
+                "this sampler is single-use, and no longer open"
+            )
+
+    @property
+    def is_open(self) -> bool:
+        return True if self._reusable else self._open
+
+    def sample(self, element: Any, weight: float) -> None:
+        self._check_open()
+        self._engine.sample(element, weight)
+
+    def sample_all(self, pairs: Iterable[Tuple[Any, float]]) -> None:
+        self._check_open()
+        self._engine.sample_all(pairs)
+
+    def result(self) -> List[Any]:
+        self._check_open()
+        res = self._engine.result()
+        if not self._reusable:
+            self._open = False
+            self._engine = None  # free for GC (Sampler.scala:345-350)
+        return res
+
+
+def weighted(
+    max_sample_size: int,
+    *,
+    reusable: bool = False,
+    rng: Union[None, int, np.random.Generator] = None,
+    naive: bool = False,
+) -> WeightedSampler:
+    """Weighted reservoir sampler: k items with inclusion biased by weight
+    (Efraimidis-Spirakis keys; A-ExpJ jumps by default, ``naive=True`` for
+    the exact A-ES construction used as distributional ground truth)."""
+    from .oracle.weighted import AExpJOracle, NaiveWeightedOracle
+
+    cls = NaiveWeightedOracle if naive else AExpJOracle
+    engine = cls(max_sample_size, _resolve_rng(rng))
+    return WeightedSampler(engine, reusable)

@@ -98,6 +98,13 @@ class SamplerConfig:
       weighted: A-ExpJ weighted mode (capability beyond the reference).
       mesh_axis: mesh axis name the reservoir dimension is sharded over
         (None = single device).
+      impl: steady-state kernel selection.  ``"auto"`` (default) dispatches
+        eligible updates (steady state, full tiles, identity map, supported
+        dtypes, R divisible by the row block) to the Pallas TPU kernel on
+        TPU backends and the XLA path everywhere else; ``"xla"`` never uses
+        Pallas; ``"pallas"`` forces the Pallas kernel for eligible updates
+        (Mosaic interpreter on CPU) and fails construction if the config can
+        never be eligible.
     """
 
     max_sample_size: int
@@ -109,6 +116,7 @@ class SamplerConfig:
     distinct: bool = False
     weighted: bool = False
     mesh_axis: Optional[str] = None
+    impl: str = "auto"
 
     def __post_init__(self) -> None:
         validate_max_sample_size(self.max_sample_size)
@@ -116,6 +124,10 @@ class SamplerConfig:
             raise ValueError("num_reservoirs must be positive")
         if self.tile_size <= 0:
             raise ValueError("tile_size must be positive")
+        if self.impl not in ("auto", "xla", "pallas"):
+            raise ValueError(
+                f"impl must be 'auto', 'xla' or 'pallas', got {self.impl!r}"
+            )
 
     @property
     def k(self) -> int:

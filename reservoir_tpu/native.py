@@ -1,4 +1,4 @@
-"""ctypes loader for the native host helpers (``native/staging_buffer.cc``).
+"""ctypes loader for the native host helpers (``_native/staging_buffer.cc``).
 
 The runtime around the TPU compute path is native where it matters: the
 stream bridge's interleaved demux — scattering (stream_id, element) pairs
@@ -6,11 +6,13 @@ into per-stream staging rows — is an interpreter-speed loop in Python and a
 pointer walk in C++ (SURVEY §7.3: the host feed, not the kernel, is the
 likely bottleneck at 1e9 elem/s).
 
-Loading is best-effort with a silent build attempt (``make`` in ``native/``)
-and a pure-numpy fallback: the framework never *requires* the .so — it only
-gets faster with it.  ``NativeStaging.available()`` reports which path is in
-use; ``RESERVOIR_TPU_NO_NATIVE=1`` forces the fallback (used by tests to
-cover both).
+Loading is best-effort with a build attempt (``make`` in
+``reservoir_tpu/_native/``) and a pure-numpy fallback: the framework never
+*requires* the .so — it only gets faster with it.  ``NativeStaging.available()``
+reports which path is in use, :func:`load_error` why loading failed (the
+build is no longer *silently* best-effort); ``RESERVOIR_TPU_NO_NATIVE=1``
+forces the fallback (used by tests to cover both).  Loading is guarded by a
+lock so concurrent first use cannot race into duplicate builds.
 """
 
 from __future__ import annotations
@@ -18,45 +20,60 @@ from __future__ import annotations
 import ctypes
 import os
 import subprocess
+import threading
 from typing import Optional
 
 import numpy as np
 
-__all__ = ["NativeStaging", "load_library"]
+__all__ = ["NativeStaging", "load_library", "load_error"]
 
-_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-_NATIVE_DIR = os.path.join(_REPO_ROOT, "native")
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "_native")
 _SO_PATH = os.path.join(_NATIVE_DIR, "libreservoir_host.so")
 
 _lib: Optional[ctypes.CDLL] = None
 _load_attempted = False
+_load_error: Optional[str] = None
+_load_lock = threading.Lock()
+
+
+def load_error() -> Optional[str]:
+    """Why the last :func:`load_library` attempt failed (None = no failure)."""
+    return _load_error
 
 
 def load_library(rebuild: bool = False) -> Optional[ctypes.CDLL]:
     """Load (building on first use if needed) the native library; None if
-    unavailable — callers fall back to numpy."""
-    global _lib, _load_attempted
+    unavailable — callers fall back to numpy, and :func:`load_error` says why."""
+    global _lib, _load_attempted, _load_error
     if os.environ.get("RESERVOIR_TPU_NO_NATIVE") == "1":
         return None
-    if _lib is not None and not rebuild:
-        return _lib
-    if _load_attempted and not rebuild:
-        return _lib
-    _load_attempted = True
-    if not os.path.exists(_SO_PATH) or rebuild:
+    with _load_lock:
+        if _lib is not None and not rebuild:
+            return _lib
+        if _load_attempted and not rebuild:
+            return _lib
+        _load_attempted = True
+        if not os.path.exists(_SO_PATH) or rebuild:
+            try:
+                subprocess.run(
+                    ["make", "-C", _NATIVE_DIR],
+                    check=True,
+                    capture_output=True,
+                    timeout=120,
+                )
+            except (OSError, subprocess.SubprocessError) as e:
+                _load_error = f"native build failed: {e}"
+                return None
         try:
-            subprocess.run(
-                ["make", "-C", _NATIVE_DIR],
-                check=True,
-                capture_output=True,
-                timeout=120,
-            )
-        except (OSError, subprocess.SubprocessError):
+            lib = ctypes.CDLL(_SO_PATH)
+        except OSError as e:
+            _load_error = f"dlopen failed: {e}"
             return None
-    try:
-        lib = ctypes.CDLL(_SO_PATH)
-    except OSError:
-        return None
+        return _finish_load(lib)
+
+
+def _finish_load(lib: ctypes.CDLL) -> ctypes.CDLL:
+    global _lib
     lib.rsv_staging_create.restype = ctypes.c_void_p
     lib.rsv_staging_create.argtypes = [ctypes.c_int32] * 4
     lib.rsv_staging_destroy.argtypes = [ctypes.c_void_p]

@@ -13,10 +13,13 @@ Bit-equivalence with the vmap path is by construction, not by luck: both
 paths run the *same* ``_advance_words`` trace (threefry counter draws keyed
 on the absolute accept index, :mod:`reservoir_tpu.ops.threefry`), so
 ``update_steady_pallas(state, tile) == update_steady(state, tile)`` holds
-exactly — pinned by ``tests/test_pallas_algl.py`` in interpret mode on CPU
-and re-checked on device.
+exactly — pinned by ``tests/test_pallas_algl.py`` in interpret mode on CPU,
+and on hardware by the device-gated ``tests/test_pallas_device.py`` (skipped
+when no TPU backend is available; Mosaic's lowering of the log/exp chain in
+``_advance_words`` is only truly exercised there).
 
-Scope (the engine falls back to the XLA path otherwise): steady state only
+Scope (``ReservoirEngine._update_fn`` dispatches here via :func:`supports`
+and falls back to the XLA path otherwise): steady state only
 (every reservoir past its fill phase — the reference's hot regime,
 ``Sampler.scala:257``), full tiles (no ``valid`` raggedness), identity
 ``map_fn``, int32 counters, and R divisible by the row-block size.

@@ -26,8 +26,14 @@ bias source.  ``map`` applies to every element (it feeds the hash,
 ``Sampler.scala:155, 395``).  Tile-split invariance holds because the merge
 is associative and order-insensitive.
 
-Sample dtype must be a 32-bit integer type for now: the default hash and the
-dedup key embed the value's 4-byte pattern (validated at :func:`init`).
+Sample dtypes: any 32-bit integer type natively, and 64-bit integer keys
+(the realistic dedup workload, ``Sampler.scala:173-180`` takes any ``B`` +
+hash) via **bit-plane storage** — a 64-bit value lives as two ``[R, k]``
+uint32 planes (``value_hi`` + ``values``), never as a device int64: TPU has
+no native 64-bit lanes, so the planes keep every op on the fast uint32 VPU
+path and x64 mode stays off.  Callers feed 64-bit tiles as an
+``(hi, lo)``-plane pair (the engine splits host int64 arrays automatically)
+and reassemble results with :func:`assemble_values`.
 """
 
 from __future__ import annotations
@@ -42,7 +48,16 @@ import numpy as np
 
 from .hashing import default_hash64, scramble64
 
-__all__ = ["DistinctState", "init", "update", "update_steady", "result", "merge"]
+__all__ = [
+    "DistinctState",
+    "init",
+    "update",
+    "update_steady",
+    "result",
+    "merge",
+    "assemble_values",
+    "split_values",
+]
 
 _U32_MAX = jnp.uint32(0xFFFFFFFF)
 
@@ -53,14 +68,51 @@ class DistinctState(NamedTuple):
     Entries ``[r, i]`` for ``i < size[r]`` are the current bottom-k, sorted by
     scrambled hash ascending; the rest are canonical padding (hash = MAX,
     value = 0) marked by ``size``.
+
+    ``value_hi`` is None for 4-byte sample dtypes (``values`` carries the
+    sample dtype directly).  For 8-byte integer keys, ``values`` is the low
+    uint32 bit-plane and ``value_hi`` the high plane —
+    :func:`assemble_values` reassembles host-side.
     """
 
-    values: jax.Array  # [R, k] sample dtype
+    values: jax.Array  # [R, k] sample dtype (narrow) / uint32 lo plane (wide)
     hash_hi: jax.Array  # [R, k] uint32
     hash_lo: jax.Array  # [R, k] uint32
     size: jax.Array  # [R] int32
     count: jax.Array  # [R] count dtype — total elements seen
     salts: jax.Array  # [R, 4] uint32 — (r0_hi, r0_lo, r1_hi, r1_lo)
+    value_hi: Optional[jax.Array] = None  # [R, k] uint32 — 64-bit key mode
+
+    @property
+    def wide(self) -> bool:
+        """True when this state stores 64-bit keys as bit-planes."""
+        return self.value_hi is not None
+
+
+def split_values(values: np.ndarray) -> Tuple[jax.Array, jax.Array]:
+    """Split a host int64/uint64 array into ``(hi, lo)`` uint32 device planes
+    — the wide-mode tile format."""
+    v = np.asarray(values)
+    if v.dtype.itemsize != 8 or v.dtype.kind not in "iu":
+        raise ValueError(f"expected a 64-bit integer array, got {v.dtype}")
+    u = v.view(np.uint64)
+    hi = (u >> np.uint64(32)).astype(np.uint32)
+    lo = (u & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+    return jnp.asarray(hi), jnp.asarray(lo)
+
+
+def assemble_values(
+    values, value_hi, sample_dtype: Any
+) -> np.ndarray:
+    """Host-side inverse of the bit-plane storage: reassemble user-dtype
+    values from a (possibly wide) state's value arrays."""
+    sample_dtype = np.dtype(sample_dtype)
+    vlo = np.asarray(values)
+    if value_hi is None:
+        return vlo.view(sample_dtype) if vlo.dtype != sample_dtype else vlo
+    hi = np.asarray(value_hi).astype(np.uint64)
+    lo = np.asarray(vlo).astype(np.uint64)
+    return ((hi << np.uint64(32)) | lo).view(sample_dtype)
 
 
 def init(
@@ -71,28 +123,81 @@ def init(
     count_dtype: Any = jnp.int32,
 ) -> DistinctState:
     """Empty reservoirs with per-instance salts drawn once
-    (``Sampler.scala:385-388``)."""
+    (``Sampler.scala:385-388``).  8-byte integer ``sample_dtype`` selects
+    wide (bit-plane) storage."""
     sample_dtype = jnp.dtype(sample_dtype)
     if not (
-        jnp.issubdtype(sample_dtype, jnp.integer) and sample_dtype.itemsize == 4
+        jnp.issubdtype(sample_dtype, jnp.integer)
+        and sample_dtype.itemsize in (4, 8)
     ):
         raise ValueError(
-            "distinct mode currently requires a 32-bit integer sample dtype "
+            "distinct mode requires a 32- or 64-bit integer sample dtype "
             f"(value bits feed the hash and dedup key); got {sample_dtype}"
         )
+    wide = sample_dtype.itemsize == 8
     salts = jr.bits(key, (num_reservoirs, 4), jnp.uint32)
     return DistinctState(
-        values=jnp.zeros((num_reservoirs, k), sample_dtype),
+        values=jnp.zeros(
+            (num_reservoirs, k), jnp.uint32 if wide else sample_dtype
+        ),
         hash_hi=jnp.full((num_reservoirs, k), _U32_MAX),
         hash_lo=jnp.full((num_reservoirs, k), _U32_MAX),
         size=jnp.zeros((num_reservoirs,), jnp.int32),
         count=jnp.zeros((num_reservoirs,), count_dtype),
         salts=salts,
+        value_hi=jnp.zeros((num_reservoirs, k), jnp.uint32) if wide else None,
     )
+
+
+def _value_planes(batch) -> Tuple[jax.Array, jax.Array]:
+    """Uniform bit-plane view of a batch: an ``(hi, lo)`` uint32 pair for
+    wide tiles, a sign-extended embedding (same as :func:`default_hash64`)
+    for 4-byte tiles."""
+    if isinstance(batch, tuple):
+        bhi, blo = batch
+        return bhi.astype(jnp.uint32), blo.astype(jnp.uint32)
+    hi, lo = default_hash64(batch)
+    return hi.astype(jnp.uint32), lo.astype(jnp.uint32)
+
+
+def _bottom_k_merge(pad, hhi, hlo, vhi, vlo, k: int):
+    """Shared sort-dedup-truncate core of :func:`update` and :func:`merge`.
+
+    One code path for narrow and wide keys: values travel as uint32
+    bit-planes, dedup groups on the full (hash, value-bits) key.  Two
+    ``lax.sort`` passes of ``len(pad)`` lanes replace the reference's
+    per-element heap ops.
+    """
+    # sort by (pad, hash, value-bits): equal values -> equal hashes -> adjacent
+    pad, hhi, hlo, vhi, vlo = jax.lax.sort(
+        (pad, hhi, hlo, vhi, vlo), num_keys=5
+    )
+    same_as_prev = (
+        (pad == jnp.roll(pad, 1))
+        & (hhi == jnp.roll(hhi, 1))
+        & (hlo == jnp.roll(hlo, 1))
+        & (vhi == jnp.roll(vhi, 1))
+        & (vlo == jnp.roll(vlo, 1))
+    )
+    same_as_prev = same_as_prev.at[0].set(False)
+    drop = same_as_prev | (pad == 1)
+
+    # demote duplicates and padding to canonical padding, re-sort, keep k
+    hhi = jnp.where(drop, _U32_MAX, hhi)
+    hlo = jnp.where(drop, _U32_MAX, hlo)
+    vhi = jnp.where(drop, jnp.uint32(0), vhi)
+    vlo = jnp.where(drop, jnp.uint32(0), vlo)
+    pad2 = drop.astype(jnp.uint32)
+    pad2, hhi, hlo, vhi, vlo = jax.lax.sort(
+        (pad2, hhi, hlo, vhi, vlo), num_keys=3
+    )
+    n_unique = jnp.sum(1 - pad2).astype(jnp.int32)
+    return hhi[:k], hlo[:k], vhi[:k], vlo[:k], jnp.minimum(n_unique, k)
 
 
 def _update_one(
     values,
+    value_hi,
     hash_hi,
     hash_lo,
     size,
@@ -103,14 +208,15 @@ def _update_one(
     k: int,
     map_fn: Optional[Callable],
     hash_fn: Optional[Callable],
+    wide: bool,
 ):
     """Single-reservoir tile merge (vmapped over R)."""
-    bsz = batch.shape[0]
+    bsz = batch[0].shape[0] if isinstance(batch, tuple) else batch.shape[0]
     mapped = map_fn(batch) if map_fn is not None else batch  # every element
     if hash_fn is not None:
         bhi, blo = hash_fn(mapped)
     else:
-        bhi, blo = default_hash64(mapped)
+        bhi, blo = _value_planes(mapped)  # identity embedding (Sampler.scala:75)
     bhi, blo = scramble64(
         bhi.astype(jnp.uint32),
         blo.astype(jnp.uint32),
@@ -119,76 +225,83 @@ def _update_one(
         salts[2],
         salts[3],
     )
+    bvhi, bvlo = _value_planes(mapped)
 
     in_tile = jnp.arange(bsz) < valid
     # pad key: carried padding (>= size) and masked tile lanes sort last
     carried_pad = (jnp.arange(k) >= size).astype(jnp.uint32)
     tile_pad = (~in_tile).astype(jnp.uint32)
 
-    m_values = jnp.concatenate([values, jnp.asarray(mapped, values.dtype)])
+    cvlo = values if wide else values.view(jnp.uint32)
+    cvhi = value_hi if wide else _carried_hi(values)
+    m_pad = jnp.concatenate([carried_pad, tile_pad])
     m_hi = jnp.concatenate([hash_hi, bhi])
     m_lo = jnp.concatenate([hash_lo, blo])
-    m_pad = jnp.concatenate([carried_pad, tile_pad])
-    # stable sortable view of the value for tie-grouping (dedup key);
-    # init() guarantees a 4-byte integer dtype
-    m_vbits = m_values.view(jnp.uint32)
+    m_vhi = jnp.concatenate([cvhi, bvhi])
+    m_vlo = jnp.concatenate([cvlo, bvlo])
 
-    # sort by (pad, hash, value-bits): equal values -> equal hashes -> adjacent
-    m_pad, m_hi, m_lo, m_vbits, m_values = jax.lax.sort(
-        (m_pad, m_hi, m_lo, m_vbits, m_values), num_keys=4
+    new_hi, new_lo, new_vhi, new_vlo, new_size = _bottom_k_merge(
+        m_pad, m_hi, m_lo, m_vhi, m_vlo, k
     )
-    same_as_prev = (
-        (m_pad == jnp.roll(m_pad, 1))
-        & (m_hi == jnp.roll(m_hi, 1))
-        & (m_lo == jnp.roll(m_lo, 1))
-        & (m_vbits == jnp.roll(m_vbits, 1))
-    )
-    same_as_prev = same_as_prev.at[0].set(False)
-    dup_or_pad = same_as_prev | (m_pad == 1)
-
-    # demote duplicates and padding to canonical padding, re-sort, keep k
-    m_hi = jnp.where(dup_or_pad, _U32_MAX, m_hi)
-    m_lo = jnp.where(dup_or_pad, _U32_MAX, m_lo)
-    m_pad2 = dup_or_pad.astype(jnp.uint32)
-    m_values = jnp.where(dup_or_pad, jnp.zeros((), m_values.dtype), m_values)
-    m_pad2, m_hi, m_lo, m_values = jax.lax.sort(
-        (m_pad2, m_hi, m_lo, m_values), num_keys=3
-    )
-
-    new_values = m_values[:k]
-    new_hi = m_hi[:k]
-    new_lo = m_lo[:k]
-    n_unique = jnp.sum(1 - m_pad2).astype(jnp.int32)
-    new_size = jnp.minimum(n_unique, k)
     new_count = count + valid.astype(count.dtype)
-    return new_values, new_hi, new_lo, new_size, new_count
+    if wide:
+        return new_vlo, new_vhi, new_hi, new_lo, new_size, new_count
+    return (
+        new_vlo.view(values.dtype),
+        new_vhi,  # recomputed view, discarded by the caller in narrow mode
+        new_hi,
+        new_lo,
+        new_size,
+        new_count,
+    )
+
+
+def _carried_hi(values) -> jax.Array:
+    """Sign-extension plane of carried 4-byte values (dedup key symmetry
+    with the tile side's :func:`_value_planes`)."""
+    hi, _ = default_hash64(values)
+    return hi.astype(jnp.uint32)
 
 
 def update(
     state: DistinctState,
-    batch: jax.Array,
+    batch,
     valid: Optional[jax.Array] = None,
     map_fn: Optional[Callable] = None,
     hash_fn: Optional[Callable] = None,
 ) -> DistinctState:
     """Merge one ``[R, B]`` tile into the bottom-k state.
 
-    ``hash_fn`` (optional) maps a mapped-value tile to a ``(hi, lo)`` uint32
-    pair *before* salting — the user-hash hook of ``Sampler.distinct``
-    (``Sampler.scala:173-180``); default embeds int32 values sign-extended.
+    ``batch`` is a ``[R, B]`` array of the sample dtype, or — in wide
+    (64-bit key) mode — an ``(hi, lo)`` pair of ``[R, B]`` uint32 planes
+    (:func:`split_values`).  ``hash_fn`` (optional) maps a mapped-value tile
+    to a ``(hi, lo)`` uint32 pair *before* salting — the user-hash hook of
+    ``Sampler.distinct`` (``Sampler.scala:173-180``); the default embeds the
+    value bits identically to the CPU oracle's default hash.
     """
     k = state.values.shape[1]
+    wide = state.wide
+    if wide and not isinstance(batch, tuple):
+        raise ValueError(
+            "wide (64-bit key) states take batches as (hi, lo) uint32 plane "
+            "pairs; see ops.distinct.split_values"
+        )
     if valid is None:
-        valid_arg = jnp.asarray(batch.shape[1], jnp.int32)
-        in_axes = (0, 0, 0, 0, 0, 0, 0, None)
+        bsz = batch[0].shape[1] if wide else batch.shape[1]
+        valid_arg = jnp.asarray(bsz, jnp.int32)
+        valid_ax = None
     else:
         valid_arg = valid
-        in_axes = (0, 0, 0, 0, 0, 0, 0, 0)
-    values, hi, lo, size, count = jax.vmap(
-        functools.partial(_update_one, k=k, map_fn=map_fn, hash_fn=hash_fn),
-        in_axes=in_axes,
+        valid_ax = 0
+    vhi_ax = 0 if wide else None
+    values, value_hi, hi, lo, size, count = jax.vmap(
+        functools.partial(
+            _update_one, k=k, map_fn=map_fn, hash_fn=hash_fn, wide=wide
+        ),
+        in_axes=(0, vhi_ax, 0, 0, 0, 0, 0, 0, valid_ax),
     )(
         state.values,
+        state.value_hi,
         state.hash_hi,
         state.hash_lo,
         state.size,
@@ -197,7 +310,10 @@ def update(
         batch,
         valid_arg,
     )
-    return DistinctState(values, hi, lo, size, count, state.salts)
+    return DistinctState(
+        values, hi, lo, size, count, state.salts,
+        value_hi=value_hi if wide else None,
+    )
 
 
 #: Distinct mode has no fill/steady split — the merge is one code path.
@@ -214,54 +330,49 @@ def merge(state_a: DistinctState, state_b: DistinctState) -> DistinctState:
     ``count`` adds; tile-split invariance extends across shards.
     """
     k = state_a.values.shape[1]
+    wide = state_a.wide
+    if wide != state_b.wide:
+        raise ValueError("cannot merge narrow and wide distinct states")
 
-    def one(va, hia, loa, sza, ca, vb, hib, lob, szb, cb, salts):
-        pad_a = (jnp.arange(k) >= sza).astype(jnp.uint32)
-        pad_b = (jnp.arange(k) >= szb).astype(jnp.uint32)
-        m_values = jnp.concatenate([va, vb])
+    def one(va, vha, hia, loa, sza, ca, vb, vhb, hib, lob, szb, cb):
+        pad = jnp.concatenate(
+            [
+                (jnp.arange(k) >= sza).astype(jnp.uint32),
+                (jnp.arange(k) >= szb).astype(jnp.uint32),
+            ]
+        )
         m_hi = jnp.concatenate([hia, hib])
         m_lo = jnp.concatenate([loa, lob])
-        m_pad = jnp.concatenate([pad_a, pad_b])
-        m_vbits = m_values.view(jnp.uint32)
-        m_pad, m_hi, m_lo, m_vbits, m_values = jax.lax.sort(
-            (m_pad, m_hi, m_lo, m_vbits, m_values), num_keys=4
+        if wide:
+            m_vhi = jnp.concatenate([vha, vhb])
+            m_vlo = jnp.concatenate([va, vb])
+        else:
+            m_vhi = jnp.concatenate([_carried_hi(va), _carried_hi(vb)])
+            m_vlo = jnp.concatenate([va, vb]).view(jnp.uint32)
+        n_hi, n_lo, n_vhi, n_vlo, n_size = _bottom_k_merge(
+            pad, m_hi, m_lo, m_vhi, m_vlo, k
         )
-        same = (
-            (m_pad == jnp.roll(m_pad, 1))
-            & (m_hi == jnp.roll(m_hi, 1))
-            & (m_lo == jnp.roll(m_lo, 1))
-            & (m_vbits == jnp.roll(m_vbits, 1))
-        )
-        same = same.at[0].set(False)
-        drop = same | (m_pad == 1)
-        m_hi = jnp.where(drop, _U32_MAX, m_hi)
-        m_lo = jnp.where(drop, _U32_MAX, m_lo)
-        m_values = jnp.where(drop, jnp.zeros((), m_values.dtype), m_values)
-        m_pad2 = drop.astype(jnp.uint32)
-        m_pad2, m_hi, m_lo, m_values = jax.lax.sort(
-            (m_pad2, m_hi, m_lo, m_values), num_keys=3
-        )
-        n_unique = jnp.sum(1 - m_pad2).astype(jnp.int32)
-        return (
-            m_values[:k],
-            m_hi[:k],
-            m_lo[:k],
-            jnp.minimum(n_unique, k),
-            ca + cb,
-        )
+        n_values = n_vlo if wide else n_vlo.view(va.dtype)
+        return n_values, n_vhi, n_hi, n_lo, n_size, ca + cb
 
-    values, hi, lo, size, count = jax.vmap(one)(
-        state_a.values, state_a.hash_hi, state_a.hash_lo, state_a.size,
-        state_a.count,
-        state_b.values, state_b.hash_hi, state_b.hash_lo, state_b.size,
-        state_b.count,
-        state_a.salts,
+    vh_ax = 0 if wide else None
+    values, value_hi, hi, lo, size, count = jax.vmap(
+        one, in_axes=(0, vh_ax, 0, 0, 0, 0, 0, vh_ax, 0, 0, 0, 0)
+    )(
+        state_a.values, state_a.value_hi, state_a.hash_hi, state_a.hash_lo,
+        state_a.size, state_a.count,
+        state_b.values, state_b.value_hi, state_b.hash_hi, state_b.hash_lo,
+        state_b.size, state_b.count,
     )
-    return DistinctState(values, hi, lo, size, count, state_a.salts)
+    return DistinctState(
+        values, hi, lo, size, count, state_a.salts,
+        value_hi=value_hi if wide else None,
+    )
 
 
 def result(state: DistinctState) -> Tuple[jax.Array, jax.Array]:
     """``(values [R, k], size [R])``, sorted by scrambled hash ascending —
     the order the contract leaves unspecified (``Sampler.scala:411``), made
-    canonical (and oracle-comparable) here."""
+    canonical (and oracle-comparable) here.  Wide states return the low
+    plane; reassemble with :func:`assemble_values` (+ ``state.value_hi``)."""
     return state.values, state.size

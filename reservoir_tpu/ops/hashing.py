@@ -123,3 +123,27 @@ def draw_salts(rng: np.random.Generator) -> Tuple[int, int]:
     return int(rng.integers(0, 1 << 64, dtype=np.uint64)), int(
         rng.integers(0, 1 << 64, dtype=np.uint64)
     )
+
+
+def as_scalar_hash(tile_hash_fn: Any):
+    """One hash definition for both layers (VERDICT r1 item 6).
+
+    A user hash for the device kernel is array-level:
+    ``tile_hash_fn(values) -> (hi, lo)`` uint32 arrays.  Because this module
+    is backend-agnostic (NumPy and jax.numpy share the ufunc surface), the
+    same function runs on host arrays — this adapter derives the CPU
+    oracle's scalar form (``value -> 64-bit int``, the
+    ``Sampler.distinct`` hash shape, ``Sampler.scala:173``) by feeding a
+    1-element array:
+
+        tile_hash = lambda v: (v >> 16, v * 31)          # one definition
+        api.distinct(k, hash_fn=as_scalar_hash(tile_hash))  # host layer
+        ReservoirEngine(cfg, hash_fn=tile_hash)             # device layer
+    """
+
+    def scalar_hash(value) -> int:
+        arr = np.asarray([value])
+        hi, lo = tile_hash_fn(arr)
+        return (int(np.uint32(hi[0])) << 32) | int(np.uint32(lo[0]))
+
+    return scalar_hash

@@ -22,9 +22,16 @@ Tile-split invariance is bit-exact when the weight partial sums are exact in
 float32 (e.g. integer weights summing below 2^24) and within float rounding
 otherwise — the jump accumulator ``xw`` is carried across tiles as a float.
 
-Keys and ``xw`` live in log-space (SURVEY §7.3).  Weights must be strictly
-positive (the engine validates); zero-weight semantics ("never sampled")
-are available in the CPU oracle.
+Keys and ``xw`` live in log-space (SURVEY §7.3).
+
+Zero-weight contract (one contract across oracle, kernel, engine and bridge
+— VERDICT r1 item 7): weights must be **nonnegative**; ``w == 0`` means
+"counted but never sampled", exactly as the CPU oracle defines it.  Zero-
+weight items take no reservoir slot during fill (slots go to positive-weight
+items by arrival rank), contribute nothing to the jump accumulator, and can
+never be the crossing item of an exponential jump (they are flat spans of
+the weight cumsum).  Negative weights raise wherever weights cross the host
+boundary.
 """
 
 from __future__ import annotations
@@ -100,17 +107,30 @@ def _update_one(
     count_dtype = count.dtype
     in_tile = jnp.arange(bsz) < valid
     idx_abs = count + jnp.arange(1, bsz + 1, dtype=count_dtype)
-    w_masked = jnp.where(in_tile, weights.astype(jnp.float32), 0.0)
+    wf = weights.astype(jnp.float32)
+    positive = in_tile & (wf > 0.0)  # zero-weight: counted, never sampled
+    w_masked = jnp.where(in_tile, wf, 0.0)
     cw = jnp.cumsum(w_masked)
     total_w = jnp.where(valid > 0, cw[bsz - 1], 0.0)
+    # filled slots are a prefix by construction; -inf lkey == empty slot
+    # (fill keys are clamped finite below so the sentinel is unambiguous)
+    n_filled = jnp.sum(lkeys > _NEG_INF).astype(jnp.int32)
+    need = jnp.maximum(k - n_filled, 0)
+    prank = jnp.cumsum(positive.astype(jnp.int32))  # 1-based positive rank
 
     if fill:
-        # fill phase: items with absolute index <= k take slots directly,
-        # keyed lkey = log(u)/w with u from their index's fill channel.
-        fill_mask = (idx_abs <= k) & in_tile
+        # fill phase: positive-weight items take the next free slots in
+        # arrival order (zero-weight items advance only the count — the
+        # oracle's "never sampled" contract); draws stay keyed on the
+        # absolute index so tile splits cannot change them.
+        fill_mask = positive & (prank <= need)
         u_fill = jax.vmap(lambda i: _uniforms(key, i)[0])(idx_abs)
-        lk_fill = jnp.log(u_fill) / weights.astype(jnp.float32)
-        dest = jnp.where(fill_mask, (idx_abs - 1).astype(jnp.int32), k)
+        lk_fill = jnp.where(
+            positive, jnp.log(u_fill) / jnp.maximum(wf, jnp.float32(1e-45)),
+            _NEG_INF,
+        )
+        lk_fill = jnp.maximum(lk_fill, jnp.finfo(jnp.float32).min)
+        dest = jnp.where(fill_mask, n_filled + prank - 1, k)
         values = map_fn(elems) if map_fn is not None else elems
         samples = samples.at[dest].set(
             jnp.asarray(values, samples.dtype), mode="drop"
@@ -118,12 +138,16 @@ def _update_one(
         lkeys = lkeys.at[dest].set(lk_fill, mode="drop")
         # fill completing inside this tile draws the first jump, keyed on
         # index k, against the threshold of the just-filled reservoir
-        completes = (count < k) & (count + valid.astype(count_dtype) >= k)
+        n_pos = jnp.where(valid > 0, prank[bsz - 1], 0)
+        completes = (n_filled < k) & (n_filled + n_pos >= k)
         u3_init = _uniforms(key, jnp.asarray(k, count_dtype))[2]
         xw = jnp.where(completes, _draw_xw(u3_init, jnp.min(lkeys)), xw)
 
-    # acceptance scanning starts after any fill positions in this tile
-    start = jnp.clip(k - count, 0, bsz).astype(jnp.int32)
+    # acceptance scanning starts after the fill-completing item (the
+    # ``need``-th positive item of the tile); an unfinished fill leaves
+    # start == bsz with xw still +inf -> no acceptances
+    j0 = jnp.searchsorted(prank, need, side="left").astype(jnp.int32)
+    start = jnp.where(need > 0, jnp.minimum(j0 + 1, bsz), 0).astype(jnp.int32)
     base0 = jnp.where(start > 0, cw[jnp.maximum(start - 1, 0)], 0.0)
 
     def next_j(base, xw_c, cur):
@@ -143,7 +167,10 @@ def _update_one(
         lt = jnp.min(lkeys_c)
         t = jnp.exp(w_c * lt)
         r2 = t + u[1] * (1.0 - t)
-        lkey_new = jnp.log(r2) / w_c
+        # clamp finite: -inf is the empty-slot sentinel (result/size)
+        lkey_new = jnp.maximum(
+            jnp.log(r2) / w_c, jnp.finfo(jnp.float32).min
+        )
         slot = jnp.argmin(lkeys_c).astype(jnp.int32)
         value = map_fn(elems[j]) if map_fn is not None else elems[j]
         samples_c = samples_c.at[slot].set(jnp.asarray(value, samples_c.dtype))
@@ -242,8 +269,10 @@ def merge(state_a: WeightedState, state_b: WeightedState) -> WeightedState:
 
 
 def result(state: WeightedState) -> Tuple[jax.Array, jax.Array]:
-    """``(samples [R, k], size [R])`` — size is min(count, k)."""
+    """``(samples [R, k], size [R])`` — size is the number of filled slots
+    (equal to min(count, k) only when no zero-weight items were seen; a
+    zero-weight item counts but never occupies a slot)."""
+    size = jnp.sum(state.lkeys > _NEG_INF, axis=1).astype(state.count.dtype)
     k = state.samples.shape[1]
-    size = jnp.minimum(state.count, k).astype(state.count.dtype)
     mask = jnp.arange(k)[None, :] < size[:, None]
     return jnp.where(mask, state.samples, jnp.zeros_like(state.samples)), size

@@ -12,6 +12,7 @@ from .sharded import (
     shard_state,
     sharded_update,
     sharded_result,
+    state_shardings,
 )
 
 __all__ = [
@@ -20,4 +21,5 @@ __all__ = [
     "shard_state",
     "sharded_update",
     "sharded_result",
+    "state_shardings",
 ]

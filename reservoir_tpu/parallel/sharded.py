@@ -2,9 +2,8 @@
 
 The scaling story (SURVEY §2.4, new component): R reservoirs shard over the
 mesh's reservoir axis — 65,536 streams on a v5e-8 = 8,192 reservoirs per
-chip, updated by exactly the same pure :func:`reservoir_tpu.ops.algorithm_l`
-kernels.  We follow the pjit recipe (annotate shardings, let XLA insert
-collectives):
+chip, updated by exactly the same pure :mod:`reservoir_tpu.ops` kernels.  We
+follow the pjit recipe (annotate shardings, let XLA insert collectives):
 
 - ``update`` is embarrassingly parallel along R -> with state and tiles
   sharded ``P('res')``, XLA compiles a collective-free SPMD program; tiles
@@ -15,13 +14,21 @@ collectives):
 - cross-reservoir reductions (global counts, eviction stats) are plain
   ``jnp`` reductions on sharded arrays -> XLA lowers to ``psum`` over ICI.
 
+Every helper here is mode-generic: the three state pytrees
+(:class:`~reservoir_tpu.ops.algorithm_l.ReservoirState`,
+:class:`~reservoir_tpu.ops.distinct.DistinctState`,
+:class:`~reservoir_tpu.ops.weighted.WeightedState`) are NamedTuples whose
+leaves all carry the reservoir dimension first, so "shard the leading axis,
+replicate the rest" is a ``tree.map``.  Pass the matching ``ops`` module to
+:func:`sharded_update`/:func:`sharded_result` (default: Algorithm L).
+
 Stream-axis parallelism (one logical stream split across chips) is the
 mergeable-summary path in :mod:`reservoir_tpu.parallel.merge`.
 """
 
 from __future__ import annotations
 
-from typing import Optional, Tuple
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
@@ -33,6 +40,7 @@ from ..ops import algorithm_l as _algl
 __all__ = [
     "make_mesh",
     "reservoir_sharding",
+    "state_shardings",
     "shard_state",
     "sharded_update",
     "sharded_result",
@@ -64,62 +72,76 @@ def reservoir_sharding(mesh: Mesh, axis: str = "res") -> NamedSharding:
     return NamedSharding(mesh, P(axis))
 
 
-def shard_state(
-    state: _algl.ReservoirState, mesh: Mesh, axis: str = "res"
-) -> _algl.ReservoirState:
-    """Place every ``[R, ...]`` leaf of the state with its reservoir dimension
-    sharded over ``axis`` (samples ``[R,k]`` -> ``P(axis, None)``)."""
-    s1 = NamedSharding(mesh, P(axis))
-    s2 = NamedSharding(mesh, P(axis, None))
-    return _algl.ReservoirState(
-        samples=jax.device_put(state.samples, s2),
-        count=jax.device_put(state.count, s1),
-        nxt=jax.device_put(state.nxt, s1),
-        log_w=jax.device_put(state.log_w, s1),
-        key=jax.device_put(state.key, s1),
+def _leaf_sharding(mesh: Mesh, axis: str, ndim: int) -> NamedSharding:
+    return NamedSharding(mesh, P(axis, *([None] * (ndim - 1))))
+
+
+def state_shardings(state, mesh: Mesh, axis: str = "res"):
+    """The sharding pytree for any mode's state: leading (reservoir) dim
+    over ``axis``, everything else replicated."""
+    return jax.tree.map(lambda x: _leaf_sharding(mesh, axis, x.ndim), state)
+
+
+def shard_state(state, mesh: Mesh, axis: str = "res"):
+    """Place every ``[R, ...]`` leaf of any mode's state with its reservoir
+    dimension sharded over ``axis`` (e.g. samples ``[R,k]`` -> ``P(axis, None)``)."""
+    return jax.tree.map(
+        lambda x: jax.device_put(x, _leaf_sharding(mesh, axis, x.ndim)), state
     )
 
 
-def sharded_update(mesh: Mesh, axis: str = "res", steady: bool = False):
-    """Jitted tile update with explicit reservoir-axis shardings.
+def sharded_update(mesh: Mesh, axis: str = "res", steady: bool = False, ops=_algl):
+    """Tile update with explicit reservoir-axis shardings, any mode.
 
-    Returns ``fn(state, batch) -> state`` where ``batch`` is ``[R, B]``
+    Returns ``fn(state, batch, *extra) -> state`` where ``batch`` (and any
+    ``extra`` array, e.g. the weighted mode's weights tile) is ``[R, B]``
     sharded ``P(axis, None)``.  Collective-free SPMD: each chip updates its
     reservoir shard independently (verified in ``tests/test_sharding.py`` on a
-    virtual 8-device mesh).
+    virtual 8-device mesh).  The jit is built on first call, when the state's
+    pytree structure is known.
     """
-    base = _algl.update_steady if steady else _algl.update
-    s1 = NamedSharding(mesh, P(axis))
-    s2 = NamedSharding(mesh, P(axis, None))
-    state_shardings = _algl.ReservoirState(
-        samples=s2, count=s1, nxt=s1, log_w=s1, key=s1
-    )
-    return jax.jit(
-        lambda state, batch: base(state, batch),
-        in_shardings=(state_shardings, s2),
-        out_shardings=state_shardings,
-        donate_argnums=(0,),
-    )
+    base = ops.update_steady if steady else ops.update
+    tile_sh = NamedSharding(mesh, P(axis, None))
+    cache: dict = {}
+
+    def call(state, batch, *extra):
+        fn = cache.get(len(extra))
+        if fn is None:
+            sh = state_shardings(state, mesh, axis)
+            fn = jax.jit(
+                lambda st, b, *e: base(st, b, *e),
+                in_shardings=(sh, tile_sh) + (tile_sh,) * len(extra),
+                out_shardings=sh,
+                donate_argnums=(0,),
+            )
+            cache[len(extra)] = fn
+        return fn(state, batch, *extra)
+
+    return call
 
 
-def sharded_result(mesh: Mesh, axis: str = "res"):
-    """Jitted ``result`` that replicates the gathered sample matrix on every
-    chip — the ``all_gather`` over ICI is inserted by XLA from the replicated
-    output sharding."""
-    s1 = NamedSharding(mesh, P(axis))
-    s2 = NamedSharding(mesh, P(axis, None))
-    state_shardings = _algl.ReservoirState(
-        samples=s2, count=s1, nxt=s1, log_w=s1, key=s1
-    )
+def sharded_result(mesh: Mesh, axis: str = "res", ops=_algl):
+    """``result`` that replicates the gathered sample matrix on every chip —
+    the ``all_gather`` over ICI is inserted by XLA from the replicated output
+    sharding — plus a global count reduction (psum), any mode."""
     replicated = NamedSharding(mesh, P())
+    cache: dict = {}
 
-    def fn(state):
-        samples, sizes = _algl.result(state)
-        total = jnp.sum(state.count)  # lowers to psum over the mesh
-        return samples, sizes, total
+    def call(state):
+        fn = cache.get("fn")
+        if fn is None:
 
-    return jax.jit(
-        fn,
-        in_shardings=(state_shardings,),
-        out_shardings=(replicated, replicated, replicated),
-    )
+            def body(st):
+                samples, sizes = ops.result(st)
+                total = jnp.sum(st.count)  # lowers to psum over the mesh
+                return samples, sizes, total
+
+            fn = jax.jit(
+                body,
+                in_shardings=(state_shardings(state, mesh, axis),),
+                out_shardings=(replicated, replicated, replicated),
+            )
+            cache["fn"] = fn
+        return fn(state)
+
+    return call

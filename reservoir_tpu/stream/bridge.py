@@ -61,14 +61,20 @@ class DeviceStreamBridge:
         map_fn: Optional[Any] = None,
         hash_fn: Optional[Any] = None,
         reusable: bool = False,
+        mesh: Optional[Any] = None,
     ) -> None:
         self._config = config
         self._engine = ReservoirEngine(
-            config, key=key, map_fn=map_fn, hash_fn=hash_fn, reusable=reusable
+            config,
+            key=key,
+            map_fn=map_fn,
+            hash_fn=hash_fn,
+            reusable=reusable,
+            mesh=mesh,
         )
         self._reusable = reusable
         S, B = config.num_reservoirs, config.tile_size
-        # staging is native (C++ demux, native/staging_buffer.cc) when the
+        # staging is native (C++ demux, _native/staging_buffer.cc) when the
         # helper library is available, numpy otherwise — same semantics
         self._staging = NativeStaging(
             S, B, np.dtype(config.element_dtype), weighted=config.weighted
@@ -164,8 +170,8 @@ class DeviceStreamBridge:
             warr = np.atleast_1d(np.ascontiguousarray(weights, np.float32))
             if warr.shape != arr.shape:
                 raise ValueError("weights must match elements shape")
-            if not np.all(warr > 0):
-                raise ValueError("weights must be strictly positive")
+            if not np.all(warr >= 0):
+                raise ValueError("weights must be nonnegative")
             return warr
         if weights is not None:
             raise ValueError("weights are only meaningful with weighted=True")
@@ -198,10 +204,10 @@ class DeviceStreamBridge:
             return
         with trace_span("reservoir_bridge_flush"):
             if self._wtile is not None:
-                # stale weight-slots past valid may hold old values; the
-                # valid mask keeps them out of sampling, but the engine's
-                # host-side positivity check must still pass
-                np.maximum(self._wtile, 1e-30, out=self._wtile)
+                # stale weight-slots past each row's valid count hold old
+                # (nonnegative) weights; the valid mask keeps them out of
+                # sampling and user weights are never rewritten (the r1
+                # 1e-30 clamp silently mutated legitimate denormal weights)
                 self._engine.sample(
                     self._tile, valid=self._valid, weights=self._wtile
                 )

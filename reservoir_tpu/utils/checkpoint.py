@@ -58,7 +58,9 @@ def _pack_state(state: Any) -> Tuple[dict, dict]:
     arrays: dict = {}
     fields = []
     for name, value in zip(type(state)._fields, state):
-        if jax.dtypes.issubdtype(value.dtype, jax.dtypes.prng_key):
+        if value is None:  # optional field (e.g. DistinctState.value_hi)
+            fields.append({"name": name, "kind": "none"})
+        elif jax.dtypes.issubdtype(value.dtype, jax.dtypes.prng_key):
             arrays[name] = np.asarray(jr.key_data(value))
             fields.append(
                 {"name": name, "kind": "prng_key", "impl": str(jr.key_impl(value))}
@@ -76,6 +78,9 @@ def _unpack_state(arrays: dict, manifest: dict) -> Any:
     cls = _state_registry()[manifest["state_class"]]
     values = []
     for field in manifest["fields"]:
+        if field["kind"] == "none":
+            values.append(None)
+            continue
         raw = arrays[field["name"]]
         if field["kind"] == "prng_key":
             values.append(jr.wrap_key_data(jnp.asarray(raw), impl=field["impl"]))

@@ -130,3 +130,41 @@ class TestSemantics:
         s = sampler(4, rng=g)
         s.sample_all(range(20))
         assert len(s.result()) == 4
+
+
+class TestWeightedHostSampler:
+    """Host weighted factory (api.weighted): engine-capability symmetry."""
+
+    def test_lifecycle_single_use(self):
+        from reservoir_tpu import api
+
+        s = api.weighted(4, rng=0)
+        s.sample_all((i, 1.0) for i in range(100))
+        assert s.is_open
+        res = s.result()
+        assert len(res) == 4 and not s.is_open
+        with pytest.raises(SamplerClosedError):
+            s.sample(1, 1.0)
+
+    def test_reusable_and_zero_weights(self):
+        from reservoir_tpu import api
+
+        s = api.weighted(4, rng=1, reusable=True)
+        s.sample_all((i, 0.0 if i % 2 else 1.0) for i in range(200))
+        res = s.result()
+        assert all(v % 2 == 0 for v in res)
+        s.sample(7, 2.0)  # still open
+        assert s.is_open
+
+    def test_negative_weight_raises(self):
+        from reservoir_tpu import api
+
+        with pytest.raises(ValueError):
+            api.weighted(4, rng=2).sample(1, -0.5)
+
+    def test_naive_variant(self):
+        from reservoir_tpu import api
+
+        s = api.weighted(3, rng=3, naive=True)
+        s.sample_all((i, 1.0) for i in range(10))
+        assert len(s.result()) == 3

@@ -1,0 +1,231 @@
+"""64-bit distinct keys on device (VERDICT r1 item 6) + the unified hash.
+
+Wide mode stores values as (hi, lo) uint32 bit-planes — no device int64, no
+x64 flag — and must stay bit-identical to the CPU oracle fed the same int64
+keys, because distinct selection is integer-only end to end.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import jax.random as jr
+import numpy as np
+import pytest
+
+from reservoir_tpu import SamplerConfig
+from reservoir_tpu.engine import ReservoirEngine
+from reservoir_tpu.ops import distinct as dd
+from reservoir_tpu.ops.hashing import as_scalar_hash
+from reservoir_tpu.oracle import BottomKOracle
+
+SALTS = (0x0123456789ABCDEF, 0xFEDCBA9876543210)
+
+
+def with_salts(state, salts_64):
+    r0, r1 = salts_64
+    row = np.array(
+        [(r0 >> 32) & 0xFFFFFFFF, r0 & 0xFFFFFFFF,
+         (r1 >> 32) & 0xFFFFFFFF, r1 & 0xFFFFFFFF],
+        dtype=np.uint32,
+    )
+    R = state.salts.shape[0]
+    return state._replace(salts=jnp.asarray(np.tile(row, (R, 1))))
+
+
+def _update_wide(state, stream_2d):
+    return dd.update(state, dd.split_values(stream_2d))
+
+
+def _values64(state, dtype=np.int64):
+    vals = dd.assemble_values(state.values, state.value_hi, dtype)
+    return [
+        list(vals[r, : int(state.size[r])]) for r in range(vals.shape[0])
+    ]
+
+
+class TestOracleBitParity64:
+    @pytest.mark.parametrize("k,n", [(8, 100), (32, 1000), (4, 7)])
+    def test_device_equals_oracle_int64(self, k, n):
+        rng = np.random.default_rng(0)
+        stream = rng.integers(-(1 << 62), 1 << 62, n, dtype=np.int64)
+        o = BottomKOracle(k, rng, salts=SALTS)
+        o.sample_all(int(x) for x in stream)
+        state = with_salts(dd.init(jr.key(0), 1, k, sample_dtype=jnp.int64), SALTS)
+        state = _update_wide(state, stream[None, :])
+        assert [int(v) for v in _values64(state)[0]] == [int(v) for v in o.result()]
+
+    def test_uint64_keys(self):
+        rng = np.random.default_rng(1)
+        stream = rng.integers(0, 1 << 64, 300, dtype=np.uint64)
+        o = BottomKOracle(16, rng, salts=SALTS)
+        o.sample_all(int(x) for x in stream)
+        state = with_salts(
+            dd.init(jr.key(1), 1, 16, sample_dtype=jnp.uint64), SALTS
+        )
+        state = _update_wide(state, stream[None, :])
+        got = [int(v) for v in _values64(state, np.uint64)[0]]
+        assert got == [int(v) for v in o.result()]
+
+    def test_values_differing_only_in_high_bits_stay_distinct(self):
+        # the r1 restriction would have collapsed these: same low 32 bits
+        base = np.int64(0x1234ABCD)
+        stream = np.array(
+            [base + (np.int64(i) << 40) for i in range(64)], dtype=np.int64
+        )
+        state = dd.init(jr.key(2), 1, 64, sample_dtype=jnp.int64)
+        state = _update_wide(state, stream[None, :])
+        vals = _values64(state)[0]
+        assert len(vals) == 64 and len(set(vals)) == 64
+
+
+class TestWideSemantics:
+    def test_tile_split_invariance(self):
+        R, k = 3, 6
+        stream = np.random.default_rng(3).integers(
+            0, 1 << 48, (R, 30), dtype=np.int64
+        )
+        ref = _update_wide(dd.init(jr.key(4), R, k, sample_dtype=jnp.int64), stream)
+        state = dd.init(jr.key(4), R, k, sample_dtype=jnp.int64)
+        for s in (slice(0, 7), slice(7, 20), slice(20, 30)):
+            state = _update_wide(state, stream[:, s])
+        for f in ("values", "value_hi", "hash_hi", "hash_lo", "size", "count"):
+            np.testing.assert_array_equal(
+                np.asarray(getattr(ref, f)), np.asarray(getattr(state, f))
+            )
+
+    def test_merge_wide(self):
+        k = 8
+        a_stream = np.arange(0, 40, dtype=np.int64) << 35
+        b_stream = np.arange(20, 60, dtype=np.int64) << 35
+        init = lambda: with_salts(
+            dd.init(jr.key(5), 1, k, sample_dtype=jnp.int64), SALTS
+        )
+        sa = _update_wide(init(), a_stream[None, :])
+        sb = _update_wide(init(), b_stream[None, :])
+        joint = _update_wide(
+            init(), np.concatenate([a_stream, b_stream])[None, :]
+        )
+        merged = dd.merge(sa, sb)
+        assert _values64(merged) == _values64(joint)
+        assert int(merged.count[0]) == 80
+
+    def test_narrow_wide_merge_rejected(self):
+        na = dd.init(jr.key(6), 1, 4)
+        wi = dd.init(jr.key(6), 1, 4, sample_dtype=jnp.int64)
+        with pytest.raises(ValueError, match="narrow and wide"):
+            dd.merge(na, wi)
+
+    def test_wide_requires_plane_batches(self):
+        state = dd.init(jr.key(7), 1, 4, sample_dtype=jnp.int64)
+        with pytest.raises(ValueError, match="plane"):
+            dd.update(state, jnp.zeros((1, 8), jnp.int32))
+
+
+class TestEngineWide:
+    def _cfg(self, **kw):
+        base = dict(
+            max_sample_size=8,
+            num_reservoirs=4,
+            tile_size=32,
+            element_dtype="int64",
+            distinct=True,
+        )
+        base.update(kw)
+        return SamplerConfig(**base)
+
+    def test_engine_int64_lifecycle(self):
+        e = ReservoirEngine(self._cfg(), key=0)
+        stream = np.random.default_rng(8).integers(
+            0, 1 << 50, (4, 500), dtype=np.int64
+        )
+        e.sample_stream(stream)
+        samples, sizes = e.result_arrays()
+        assert samples.dtype == np.int64
+        assert (sizes == 8).all()
+        pool = set(stream.ravel().tolist())
+        assert all(int(v) in pool for v in samples.ravel())
+
+    def test_engine_rejects_narrow_tiles(self):
+        e = ReservoirEngine(self._cfg(), key=1)
+        with pytest.raises(ValueError, match="64-bit"):
+            e.sample(np.zeros((4, 32), np.int32))
+
+    def test_engine_wide_checkpoint_roundtrip(self, tmp_path):
+        mk = lambda lo: (
+            lo + np.arange(4 * 32, dtype=np.int64).reshape(4, 32)
+        ) << 33
+        a = ReservoirEngine(self._cfg(), key=2)
+        a.sample(mk(0))
+        path = str(tmp_path / "wide.npz")
+        a.save(path)
+        b = ReservoirEngine.restore(path)
+        a.sample(mk(1)); b.sample(mk(1))
+        ra, rb = a.result_arrays(), b.result_arrays()
+        np.testing.assert_array_equal(ra[0], rb[0])
+        np.testing.assert_array_equal(ra[1], rb[1])
+
+    def test_engine_wide_sharded(self):
+        stream = np.random.default_rng(9).integers(
+            0, 1 << 60, (16, 64), dtype=np.int64
+        )
+        res = []
+        for mesh_axis in (None, "res"):
+            e = ReservoirEngine(
+                self._cfg(num_reservoirs=16, mesh_axis=mesh_axis),
+                key=3,
+                reusable=True,
+            )
+            e.sample(stream)
+            res.append(e.result_arrays())
+        np.testing.assert_array_equal(res[0][0], res[1][0])
+        np.testing.assert_array_equal(res[0][1], res[1][1])
+
+
+class TestUnifiedHash:
+    def test_one_hash_serves_both_layers(self):
+        # one array-level definition; backend-agnostic ufunc surface
+        def tile_hash(v):
+            bits = (
+                v.view(np.uint32) if isinstance(v, np.ndarray)
+                else v.view("uint32")
+            )
+            lo = bits * np.uint32(2654435761)
+            hi = lo ^ np.uint32(0xDEADBEEF)
+            return hi, lo
+
+        stream = np.random.default_rng(10).integers(
+            -(1 << 31), 1 << 31, 400
+        ).astype(np.int32)
+        rng = np.random.default_rng(11)
+        o = BottomKOracle(16, rng, hash_fn=as_scalar_hash(tile_hash), salts=SALTS)
+        o.sample_all(int(x) for x in stream)
+        state = with_salts(dd.init(jr.key(10), 1, 16), SALTS)
+        state = dd.update(state, jnp.asarray(stream)[None, :], hash_fn=tile_hash)
+        values, size = dd.result(state)
+        dev = [int(v) for v in np.asarray(values)[0, : int(size[0])]]
+        assert dev == [int(v) for v in o.result()]
+
+
+class TestBridgeWide:
+    def test_bridge_int64_distinct_end_to_end(self):
+        from reservoir_tpu.stream.bridge import DeviceStreamBridge
+
+        cfg = SamplerConfig(
+            max_sample_size=8, num_reservoirs=4, tile_size=32,
+            element_dtype="int64", distinct=True,
+        )
+        bridge = DeviceStreamBridge(cfg, key=0)
+        rng = np.random.default_rng(0)
+        fed = [set() for _ in range(4)]
+        for _ in range(100):
+            s = int(rng.integers(4))
+            chunk = rng.integers(0, 1 << 50, size=7, dtype=np.int64)
+            bridge.push(s, chunk)
+            fed[s].update(chunk.tolist())
+        bridge.complete()
+        res = bridge.sample.result()
+        assert all(r.dtype == np.int64 for r in res)
+        for r, pool in zip(res, fed):
+            vals = [int(v) for v in r]
+            assert len(vals) == len(set(vals)) == min(8, len(pool))
+            assert all(v in pool for v in vals)

@@ -85,3 +85,78 @@ class TestResults:
         assert ReservoirEngine(cfg()).is_open
         assert ReservoirEngine(cfg(distinct=True)).is_open
         assert ReservoirEngine(cfg(weighted=True)).is_open
+
+
+class TestPallasDispatch:
+    """Engine-level Pallas wiring (VERDICT r1 item 2): impl='pallas' forces
+    the kernel (Mosaic interpreter on the CPU test mesh) and stays
+    bit-identical to the XLA engine; impl='auto' never picks Pallas on CPU;
+    ineligible configs fail at construction."""
+
+    def _mk(self, lo, R, B):
+        return lo + np.arange(R * B, dtype=np.int32).reshape(R, B)
+
+    def test_forced_pallas_bit_equal_to_xla(self):
+        R, k, B = 64, 8, 32
+        engines = {
+            impl: ReservoirEngine(
+                SamplerConfig(max_sample_size=k, num_reservoirs=R, impl=impl),
+                key=3,
+                reusable=True,
+            )
+            for impl in ("pallas", "xla")
+        }
+        for step in range(4):
+            for e in engines.values():
+                e.sample(self._mk(step * B, R, B))
+        # the steady-state full-tile updates went through the kernel...
+        assert any(key[3] for key in engines["pallas"]._jit_cache)
+        assert not any(key[3] for key in engines["xla"]._jit_cache)
+        # ...and produced the exact same reservoirs
+        p, x = engines["pallas"].result_arrays(), engines["xla"].result_arrays()
+        np.testing.assert_array_equal(p[0], x[0])
+        np.testing.assert_array_equal(p[1], x[1])
+
+    def test_forced_pallas_ragged_tiles_fall_back(self):
+        R, k, B = 64, 8, 16
+        e = ReservoirEngine(
+            SamplerConfig(max_sample_size=k, num_reservoirs=R, impl="pallas"),
+            key=4,
+            reusable=True,
+        )
+        e.sample(self._mk(0, R, B))  # fill: XLA path (kernel is steady-only)
+        e.sample(self._mk(B, R, B), valid=np.full((R,), B - 2, np.int32))
+        e.sample(self._mk(2 * B, R, B))  # steady full tile: kernel
+        keys = list(e._jit_cache)
+        assert any(key[3] for key in keys)
+        assert any(not key[3] for key in keys)
+
+    def test_auto_stays_xla_on_cpu(self):
+        R, k, B = 64, 8, 16
+        e = ReservoirEngine(
+            SamplerConfig(max_sample_size=k, num_reservoirs=R), key=5
+        )
+        for step in range(3):
+            e.sample(self._mk(step * B, R, B))
+        assert not any(key[3] for key in e._jit_cache)
+
+    def test_forced_pallas_rejects_ineligible_configs(self):
+        with pytest.raises(ValueError, match="divisible"):
+            ReservoirEngine(
+                SamplerConfig(max_sample_size=8, num_reservoirs=60, impl="pallas")
+            )
+        with pytest.raises(ValueError, match="duplicates"):
+            ReservoirEngine(
+                SamplerConfig(
+                    max_sample_size=8, num_reservoirs=64,
+                    distinct=True, impl="pallas",
+                ),
+                hash_fn=lambda t: (t.astype("uint32"), t.astype("uint32")),
+            )
+        with pytest.raises(ValueError, match="map_fn"):
+            ReservoirEngine(
+                SamplerConfig(max_sample_size=8, num_reservoirs=64, impl="pallas"),
+                map_fn=lambda x: x + 1,
+            )
+        with pytest.raises(ValueError, match="impl"):
+            SamplerConfig(max_sample_size=8, impl="cuda")

@@ -1,0 +1,138 @@
+"""Mesh-integrated engine + bridge (VERDICT r1 item 4): ``mesh_axis`` is real.
+
+Every test checks the one property that matters: an engine/bridge sharded
+over the virtual 8-device mesh is *bit-identical* to the single-device one
+with the same key — sharding is a placement decision, never a semantics
+decision.  All three modes are covered (the r1 gap was algl-only).
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+import pytest
+
+from reservoir_tpu import ReservoirEngine, SamplerConfig
+from reservoir_tpu.stream.bridge import DeviceStreamBridge
+
+R, K, B = 16, 8, 32
+
+
+def _cfg(**kw):
+    base = dict(max_sample_size=K, num_reservoirs=R, tile_size=B)
+    base.update(kw)
+    return SamplerConfig(**base)
+
+
+def _tile(step: int) -> np.ndarray:
+    return step * B + np.arange(R * B, dtype=np.int32).reshape(R, B)
+
+
+def _weights(step: int) -> np.ndarray:
+    return 0.25 + ((np.arange(R * B, dtype=np.float32) * 31 + step) % 97) / 32.0
+
+
+def _pair(mode_kw, **engine_kw):
+    return (
+        ReservoirEngine(_cfg(**mode_kw), key=11, reusable=True, **engine_kw),
+        ReservoirEngine(
+            _cfg(mesh_axis="res", **mode_kw), key=11, reusable=True, **engine_kw
+        ),
+    )
+
+
+def _assert_results_equal(a, b):
+    sa, za = a.result_arrays()
+    sb, zb = b.result_arrays()
+    np.testing.assert_array_equal(sa, sb)
+    np.testing.assert_array_equal(za, zb)
+
+
+def test_engine_sharded_algl_bit_identical():
+    single, sharded = _pair({})
+    for step in range(6):
+        single.sample(_tile(step))
+        sharded.sample(_tile(step))
+    # the sharded engine's state really lives distributed over the mesh
+    leaf = jax.tree.leaves(sharded._state)[0]
+    assert len(leaf.sharding.device_set) == 8
+    _assert_results_equal(single, sharded)
+
+
+def test_engine_sharded_algl_ragged_tiles():
+    single, sharded = _pair({})
+    valid = np.asarray([B - (r % 5) for r in range(R)], np.int32)
+    for step in range(4):
+        single.sample(_tile(step), valid=valid)
+        sharded.sample(_tile(step), valid=valid)
+    _assert_results_equal(single, sharded)
+
+
+def test_engine_sharded_distinct_bit_identical():
+    single, sharded = _pair({"distinct": True})
+    for step in range(4):
+        tile = _tile(step) % 64  # heavy duplication stresses dedup
+        single.sample(tile)
+        sharded.sample(tile)
+    _assert_results_equal(single, sharded)
+
+
+def test_engine_sharded_weighted_bit_identical():
+    single, sharded = _pair({"weighted": True})
+    for step in range(4):
+        w = _weights(step).reshape(R, B)
+        single.sample(_tile(step), weights=w)
+        sharded.sample(_tile(step), weights=w)
+    _assert_results_equal(single, sharded)
+
+
+def test_engine_rejects_uneven_or_meshless():
+    with pytest.raises(ValueError, match="divide"):
+        ReservoirEngine(
+            SamplerConfig(max_sample_size=4, num_reservoirs=12, mesh_axis="res")
+        )
+    from reservoir_tpu.parallel import make_mesh
+
+    with pytest.raises(ValueError, match="mesh_axis"):
+        ReservoirEngine(_cfg(), mesh=make_mesh(8))
+
+
+def test_engine_sharded_checkpoint_roundtrip(tmp_path):
+    single, sharded = _pair({})
+    for e in (single, sharded):
+        e.sample(_tile(0))
+    path = str(tmp_path / "sharded.npz")
+    sharded.save(path)
+    restored = ReservoirEngine.restore(path)
+    assert restored.config.mesh_axis == "res"
+    leaf = jax.tree.leaves(restored._state)[0]
+    assert len(leaf.sharding.device_set) == 8  # re-sharded on restore
+    for e in (single, sharded, restored):
+        e.sample(_tile(1))
+    _assert_results_equal(single, sharded)
+    # restored engine is single-use by default; compare against a fresh read
+    sr, zr = restored.result_arrays()
+    ss, zs = single.result_arrays()
+    np.testing.assert_array_equal(sr, ss)
+    np.testing.assert_array_equal(zr, zs)
+
+
+def test_bridge_sharded_end_to_end():
+    """BASELINE config 5's shape in miniature: interleaved pushes -> staging
+    demux -> sharded engine -> gathered per-stream samples."""
+    rng = np.random.default_rng(0)
+    pushes = [
+        (int(rng.integers(R)), rng.integers(0, 1 << 20, size=int(rng.integers(1, 50))))
+        for _ in range(400)
+    ]
+    results = []
+    for mesh_axis in (None, "res"):
+        bridge = DeviceStreamBridge(_cfg(mesh_axis=mesh_axis), key=23)
+        for stream, elems in pushes:
+            bridge.push(stream, np.asarray(elems, np.int32))
+        bridge.complete()
+        results.append(bridge.sample.result())
+    single, sharded = results
+    assert len(single) == len(sharded) == R
+    for a, b in zip(single, sharded):
+        np.testing.assert_array_equal(a, b)

@@ -1,0 +1,81 @@
+"""Device-gated Pallas parity: Mosaic-compiled kernel == XLA path on real TPU.
+
+The interpret-mode suite (``test_pallas_algl.py``) pins the algorithm; this
+suite pins the *lowering* — Mosaic's codegen for the log/exp chain in
+``_advance_words`` and the bitcast one-hot gather only truly run on hardware.
+
+Skipped on the CPU test mesh.  Run on the real chip with::
+
+    RESERVOIR_TPU_TEST_PLATFORM=native python -m pytest tests/test_pallas_device.py -q
+
+(``tests/conftest.py`` forces the virtual CPU mesh otherwise.)
+"""
+
+import jax
+import jax.numpy as jnp
+import jax.random as jr
+import numpy as np
+import pytest
+
+from reservoir_tpu.ops import algorithm_l as al
+from reservoir_tpu.ops import algorithm_l_pallas as alp
+
+pytestmark = pytest.mark.skipif(
+    jax.default_backend() == "cpu",
+    reason="needs a TPU backend (set RESERVOIR_TPU_TEST_PLATFORM=native)",
+)
+
+
+def _assert_state_equal(a, b):
+    np.testing.assert_array_equal(np.asarray(a.samples), np.asarray(b.samples))
+    np.testing.assert_array_equal(np.asarray(a.count), np.asarray(b.count))
+    np.testing.assert_array_equal(np.asarray(a.nxt), np.asarray(b.nxt))
+    np.testing.assert_array_equal(np.asarray(a.log_w), np.asarray(b.log_w))
+
+
+def test_device_pallas_matches_xla_int32():
+    R, k, B = 64, 128, 256
+    state = al.init(jr.key(0), R, k)
+    state = al.update(state, jax.lax.broadcasted_iota(jnp.int32, (R, B), 1))
+    batch = 10_000 + jax.lax.broadcasted_iota(jnp.int32, (R, B), 1)
+    ref = al.update_steady(state, batch)
+    got = alp.update_steady_pallas(state, batch, block_r=64)
+    _assert_state_equal(ref, got)
+
+
+def test_device_pallas_matches_xla_float32_chain():
+    R, k, B = 64, 32, 128
+    state = al.init(jr.key(1), R, k, sample_dtype=jnp.float32)
+    mk = lambda lo: lo + 0.5 + jax.lax.broadcasted_iota(jnp.float32, (R, B), 1)
+    state = al.update(state, mk(0.0))
+    s_ref = s_pal = state
+    for s in range(4):
+        s_ref = al.update_steady(s_ref, mk(1000.0 * (s + 1)))
+        s_pal = alp.update_steady_pallas(s_pal, mk(1000.0 * (s + 1)), block_r=64)
+        _assert_state_equal(s_ref, s_pal)
+
+
+def test_device_engine_auto_dispatches_pallas():
+    """On a TPU backend, impl='auto' must route steady full tiles to Mosaic
+    and stay bit-identical to an impl='xla' engine with the same key."""
+    from reservoir_tpu.config import SamplerConfig
+    from reservoir_tpu.engine import ReservoirEngine
+
+    R, k, B = 64, 16, 64
+    mk = lambda lo: lo + np.arange(R * B, dtype=np.int32).reshape(R, B)
+    engines = {
+        impl: ReservoirEngine(
+            SamplerConfig(max_sample_size=k, num_reservoirs=R, impl=impl),
+            key=7,
+            reusable=True,
+        )
+        for impl in ("auto", "xla")
+    }
+    for step in range(4):
+        for e in engines.values():
+            e.sample(mk(step * B))
+    assert any(key[3] for key in engines["auto"]._jit_cache)  # pallas used
+    assert not any(key[3] for key in engines["xla"]._jit_cache)
+    a, xs = engines["auto"].result_arrays(), engines["xla"].result_arrays()
+    np.testing.assert_array_equal(a[0], xs[0])
+    np.testing.assert_array_equal(a[1], xs[1])

@@ -1,0 +1,36 @@
+"""Executable README: every fenced python block in README.md must run.
+
+The reference's README usage snippets (``/root/reference/README.md:21-56``)
+are the de-facto contract a new user copies; this suite keeps ours honest
+(VERDICT r1 item 8) by executing each block verbatim, in order, in an
+isolated namespace per block.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+
+import pytest
+
+_README = os.path.join(os.path.dirname(__file__), os.pardir, "README.md")
+
+
+def _python_blocks():
+    with open(_README, encoding="utf-8") as f:
+        text = f.read()
+    blocks = re.findall(r"```python\n(.*?)```", text, flags=re.DOTALL)
+    assert blocks, "README.md has no python snippets"
+    # label each block with its nearest preceding heading for test ids
+    labeled = []
+    for block in blocks:
+        pos = text.index(block)
+        heading = re.findall(r"^###? (.+)$", text[:pos], flags=re.MULTILINE)[-1]
+        slug = re.sub(r"\W+", "-", heading.lower()).strip("-")
+        labeled.append(pytest.param(block, id=slug))
+    return labeled
+
+
+@pytest.mark.parametrize("block", _python_blocks())
+def test_readme_snippet_runs(block):
+    exec(compile(block, "<README.md>", "exec"), {"__name__": "__readme__"})
