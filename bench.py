@@ -27,7 +27,11 @@ Env knobs:
                                 (bridge = host-feed: interleaved demux ->
                                 staging -> device flushes, SURVEY §7.3's
                                 "actual likely bottleneck")
-  RESERVOIR_BENCH_IMPL          xla (default) | pallas   (algl only)
+  RESERVOIR_BENCH_IMPL          auto (default) | xla | pallas   (algl only;
+                                auto tries the Pallas kernel and falls back
+                                to the XLA path if Mosaic compile/run fails,
+                                so the headline number is the best impl but
+                                a lowering regression can't erase a round)
   RESERVOIR_BENCH_PLATFORM=cpu  force the CPU backend (config.update — the
                                 JAX_PLATFORMS env var belongs to the axon
                                 sitecustomize and must not be overridden)
@@ -39,6 +43,7 @@ from __future__ import annotations
 import functools
 import json
 import os
+import subprocess
 import sys
 import time
 
@@ -54,36 +59,72 @@ import numpy as np
 NORTH_STAR = 1e9  # elem/s (BASELINE.md)
 
 
+def _probe_backend(timeout_s: float) -> bool:
+    """Probe backend liveness in a THROWAWAY subprocess with a hard timeout.
+
+    The tunnel fails two ways: a fast ``RuntimeError: ... UNAVAILABLE`` and a
+    silent hang inside ``jax.devices()`` (observed 2026-07-29 — a hang in the
+    main process is unrecoverable and would eat the driver's whole timeout).
+    Probing in a subprocess makes both failure modes cheap and retryable."""
+    code = (
+        "import jax, sys; d = jax.devices(); "
+        "x = jax.numpy.zeros((8,)); float(x.sum()); "
+        "sys.stdout.write(d[0].platform)"
+    )
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", code],
+            capture_output=True,
+            timeout=timeout_s,
+            text=True,
+        )
+        return proc.returncode == 0
+    except subprocess.TimeoutExpired:
+        return False
+
+
 def _init_backend_with_retry(
-    attempts: int = 6, first_delay_s: float = 5.0
+    attempts: int = 6, first_delay_s: float = 5.0, probe_timeout_s: float = 90.0
 ) -> str:
     """Touch the backend, retrying transient tunnel failures.
 
     The axon TPU tunnel can throw ``RuntimeError: ... UNAVAILABLE`` at init
     for reasons that clear in seconds (VERDICT r1: one such hiccup erased the
-    round's official number).  Bounded exponential backoff: 5+10+20+40+80s
-    worst case before giving up for real.
-    """
+    round's official number) — or hang outright.  Each attempt first probes
+    liveness in a subprocess (hang-proof), then initializes in-process only
+    once a probe has succeeded.  Bounded exponential backoff: 5+10+20+40+80s
+    worst case between attempts."""
+    if os.environ.get("RESERVOIR_BENCH_PLATFORM"):
+        # explicitly pinned platform (e.g. cpu): init cannot hang, and the
+        # probe subprocess would touch the *default* backend instead
+        return jax.devices()[0].platform
     delay = first_delay_s
     for attempt in range(attempts):
-        try:
-            devices = jax.devices()
-            return devices[0].platform
-        except RuntimeError as e:
-            if attempt == attempts - 1:
-                raise
-            print(
-                f"bench: backend init failed (attempt {attempt + 1}/"
-                f"{attempts}): {e}; retrying in {delay:.0f}s",
-                file=sys.stderr,
-            )
-            try:  # drop any partially-initialized backend state
-                jax.extend.backend.clear_backends()
-            except Exception:
-                pass
-            time.sleep(delay)
-            delay *= 2
-    raise AssertionError("unreachable")
+        if _probe_backend(probe_timeout_s):
+            try:
+                devices = jax.devices()  # probe succeeded; init for real
+                return devices[0].platform
+            except RuntimeError as e:
+                # tunnel hiccuped between probe and in-process init — the
+                # exact fast-UNAVAILABLE case the retry loop exists for
+                print(f"bench: in-process init failed: {e}", file=sys.stderr)
+                try:  # drop any partially-initialized backend state
+                    jax.extend.backend.clear_backends()
+                except Exception:
+                    pass
+        if attempt == attempts - 1:
+            break
+        print(
+            f"bench: backend probe/init failed (attempt {attempt + 1}/"
+            f"{attempts}); retrying in {delay:.0f}s",
+            file=sys.stderr,
+        )
+        time.sleep(delay)
+        delay *= 2
+    # all probes failed — last resort: init in-process and let the error
+    # surface (the driver's tail then shows the true cause)
+    devices = jax.devices()
+    return devices[0].platform
 
 
 def _readback_barrier(state) -> int:
@@ -215,14 +256,16 @@ def _bench_weighted(R, k, B, steps, reps):
 def main() -> None:
     smoke = os.environ.get("RESERVOIR_BENCH_SMOKE") == "1"
     config = os.environ.get("RESERVOIR_BENCH_CONFIG", "algl")
-    impl = os.environ.get("RESERVOIR_BENCH_IMPL", "xla")
+    impl = os.environ.get("RESERVOIR_BENCH_IMPL", "auto")
     if config not in ("algl", "distinct", "weighted", "bridge"):
         raise SystemExit(
             "RESERVOIR_BENCH_CONFIG must be algl|distinct|weighted|bridge, "
             f"got {config!r}"
         )
-    if impl not in ("xla", "pallas"):
-        raise SystemExit(f"RESERVOIR_BENCH_IMPL must be xla|pallas, got {impl!r}")
+    if impl not in ("auto", "xla", "pallas"):
+        raise SystemExit(
+            f"RESERVOIR_BENCH_IMPL must be auto|xla|pallas, got {impl!r}"
+        )
     defaults = {
         "algl": (1024 if smoke else 65536, 128, 256 if smoke else 2048),
         "distinct": (256 if smoke else 4096, 32 if smoke else 256, 1024),
@@ -243,8 +286,26 @@ def main() -> None:
 
     with maybe_profile():  # RESERVOIR_TPU_TRACE_DIR=... captures a trace
         if config == "algl":
-            times = _bench_algl(R, k, B, steps, reps, impl)
-            tag = f"algl_{impl}"
+            if impl == "auto" and jax.default_backend() != "tpu":
+                # Mosaic lowers on TPU only; the CPU interpreter "works" but
+                # is far slower than XLA — auto must never benchmark it
+                times = _bench_algl(R, k, B, steps, reps, "xla")
+                tag = "algl_xla"
+            elif impl == "auto":
+                try:
+                    times = _bench_algl(R, k, B, steps, reps, "pallas")
+                    tag = "algl_pallas"
+                except Exception as e:  # Mosaic lowering/runtime regression
+                    print(
+                        f"bench: pallas impl failed ({type(e).__name__}: {e}); "
+                        "falling back to xla",
+                        file=sys.stderr,
+                    )
+                    times = _bench_algl(R, k, B, steps, reps, "xla")
+                    tag = "algl_xla"
+            else:
+                times = _bench_algl(R, k, B, steps, reps, impl)
+                tag = f"algl_{impl}"
         elif config == "distinct":
             times = _bench_distinct(R, k, B, steps, reps)
             tag = "distinct"

@@ -185,8 +185,11 @@ def distinct(
     ``Sampler.scala:155``); ``hash_fn`` defaults to a stable 64-bit identity/
     FNV hash (``Sampler.scala:75`` analog).
     """
-    map_fn = map_fn if map_fn is not None else _identity
-    validate_non_distinct_params(max_sample_size, map_fn)
+    # keep the user's map_fn as given (None = identity): the oracle's
+    # vectorized bulk path only engages without a per-element map hook
+    validate_non_distinct_params(
+        max_sample_size, map_fn if map_fn is not None else _identity
+    )
     if hash_fn is not None:
         from .config import validate_hash
 

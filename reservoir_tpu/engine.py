@@ -228,9 +228,9 @@ class ReservoirEngine:
             return False
         if self._config.impl == "pallas":
             return True
-        # auto: Mosaic compiles only on TPU backends; the CPU interpreter is
-        # far slower than the XLA path, so auto never picks it there
-        return jax.default_backend() != "cpu"
+        # auto: Mosaic lowers on TPU only — GPU/CPU backends take the XLA
+        # path (the CPU interpreter would also be far slower than XLA)
+        return jax.default_backend() == "tpu"
 
     def _update_fn(self, width: int, steady: bool, ragged: bool, tile_dtype):
         use_pallas = self._pallas_eligible(steady, ragged, tile_dtype)
@@ -279,7 +279,14 @@ class ReservoirEngine:
             tile = _distinct.split_values(tile_np)  # (hi, lo) uint32 planes
             tile_shape, tile_dtype = tile_np.shape, tile_np.dtype
         else:
-            tile = jnp.asarray(tile)
+            if not isinstance(tile, jax.Array):
+                # async device_put, NOT jnp.asarray: on tunneled backends
+                # asarray transfers synchronously in chunks (measured 228ms
+                # vs 2.5ms pipelined for a 4MB tile) — it would serialize
+                # every flush on host->device latency.  The host copy makes
+                # the async transfer safe against callers that reuse their
+                # buffer (the bridge's staging tile does exactly that).
+                tile = jax.device_put(np.array(tile, copy=True))
             if tile.ndim != 2 or tile.shape[0] != self._config.num_reservoirs:
                 raise ValueError(
                     f"tile must be [num_reservoirs="
@@ -295,11 +302,18 @@ class ReservoirEngine:
             # violation with undefined sampling bias, as documented).
             # w == 0 is legal everywhere: counted, never sampled (the
             # oracle's contract, ops.weighted module docs).
-            if isinstance(weights, (np.ndarray, list, tuple)):
-                weights = np.asarray(weights, np.float32)
+            if not isinstance(weights, jax.Array):
+                w_in = weights
+                weights = np.asarray(w_in, np.float32)
                 if not np.all(weights >= 0):
                     raise ValueError("weights must be nonnegative")
-            weights = jnp.asarray(weights, jnp.float32)
+                if weights is w_in:
+                    # no conversion copy happened — snapshot before the
+                    # async device_put (caller may reuse its buffer)
+                    weights = weights.copy()
+                weights = jax.device_put(weights)
+            elif weights.dtype != jnp.float32:
+                weights = weights.astype(jnp.float32)
             if tuple(weights.shape) != tuple(tile_shape):
                 raise ValueError(
                     f"weights must match tile shape {tuple(tile_shape)}, "
@@ -334,7 +348,7 @@ class ReservoirEngine:
             self._state = fn(self._state, *args)
             self._min_count += width
         else:
-            valid_np = np.asarray(valid, np.int32)
+            valid_np = np.array(valid, np.int32, copy=True)  # async-put safe
             if valid_np.shape != (self._config.num_reservoirs,):
                 raise ValueError(
                     f"valid must be [{self._config.num_reservoirs}], got {valid_np.shape}"
@@ -344,9 +358,10 @@ class ReservoirEngine:
                     f"valid entries must be in [0, {width}], got "
                     f"[{valid_np.min()}, {valid_np.max()}]"
                 )
-            valid_dev = jnp.asarray(valid_np)
-            if self._mesh is not None:
-                valid_dev = jax.device_put(valid_dev, self._row_sharding)
+            valid_dev = jax.device_put(
+                valid_np,
+                self._row_sharding if self._mesh is not None else None,
+            )
             self._state = fn(self._state, *args, valid_dev)
             self._min_count += int(valid_np.min())
 

@@ -224,11 +224,18 @@ def _update(
     fill: bool,
 ) -> ReservoirState:
     k = state.k
-    if valid is None:
-        # Full tiles: broadcast a scalar down the vmap instead of materializing
-        # a [R] constant — keeps sharding propagation trivial on meshes.
+    if valid is None and not fill:
+        # Full steady tiles: broadcast a scalar down the vmap instead of
+        # materializing a [R] constant — keeps sharding propagation trivial.
         valid_arg = jnp.asarray(batch.shape[1], jnp.int32)
         in_axes = (0, 0, 0, 0, 0, 0, None)
+    elif valid is None:
+        # Fill-capable full tiles get a per-lane valid array: the scalar
+        # variant makes XLA compile the masked fill scatter ~20x slower on
+        # TPU (measured 226ms vs 12.6ms on a [1024,1024] tile, 2026-07-29).
+        # Created inside the trace, so mesh sharding still propagates.
+        valid_arg = jnp.full((batch.shape[0],), batch.shape[1], jnp.int32)
+        in_axes = (0, 0, 0, 0, 0, 0, 0)
     else:
         valid_arg = valid
         in_axes = (0, 0, 0, 0, 0, 0, 0)

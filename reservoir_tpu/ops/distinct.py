@@ -98,7 +98,9 @@ def split_values(values: np.ndarray) -> Tuple[jax.Array, jax.Array]:
     u = v.view(np.uint64)
     hi = (u >> np.uint64(32)).astype(np.uint32)
     lo = (u & np.uint64(0xFFFFFFFF)).astype(np.uint32)
-    return jnp.asarray(hi), jnp.asarray(lo)
+    # device_put (async) over jnp.asarray (chunked-synchronous on tunneled
+    # backends); hi/lo are freshly allocated above, so the async read is safe
+    return jax.device_put(hi), jax.device_put(lo)
 
 
 def assemble_values(

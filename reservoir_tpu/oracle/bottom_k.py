@@ -29,7 +29,7 @@ from typing import Any, Callable, Iterable, List, Optional, Set, Tuple
 import numpy as np
 
 from ..config import validate_max_sample_size
-from ..ops.hashing import draw_salts, scramble64_int
+from ..ops.hashing import draw_salts, scramble64_array, scramble64_int
 
 __all__ = ["BottomKOracle"]
 
@@ -71,6 +71,7 @@ class BottomKOracle:
         salts: Optional[Tuple[int, int]] = None,
     ) -> None:
         self._k = validate_max_sample_size(int(k))
+        self._mapped = map_fn is not None  # gates the vectorized bulk path
         self._map = map_fn if map_fn is not None else lambda x: x
         self._hash = hash_fn if hash_fn is not None else _default_hash
         # Per-instance salts drawn once (Sampler.scala:385-388); injectable
@@ -95,6 +96,109 @@ class BottomKOracle:
         """Per-element hot path (``Sampler.scala:394-408``)."""
         self._count += 1
         value, h = self._scrambled(element)
+        self._insert(value, h)
+
+    def sample_all(self, elements: Iterable[Any]) -> None:
+        """Bulk path.  Integer arrays with the default map/hash take a
+        vectorized route (the ``sampleAll`` fast-path analog,
+        ``Sampler.scala:261-287``): hashes are scrambled array-at-once and
+        the Python loop touches only fill-phase and below-threshold
+        candidates — identical results to per-element calls by construction
+        (same hashes, same arrival order)."""
+        if (
+            isinstance(elements, np.ndarray)
+            and elements.ndim == 1
+            and elements.dtype.kind in "iu"
+            and elements.dtype.itemsize <= 8
+            and self._hash is _default_hash
+            and not self._mapped
+            # mixed-type streams (per-element str calls interleaved with int
+            # arrays) can't round-trip members through a numpy array
+            and all(
+                isinstance(v, (int, np.integer)) for v in self._members
+            )
+        ):
+            self._sample_all_fast(elements)
+        else:
+            for element in elements:
+                self.sample(element)
+
+    def _sample_all_fast(self, arr: np.ndarray) -> None:
+        """Chunked vectorized scan.  Exactness rests on two properties of
+        bottom-k: the threshold only ever *tightens*, so a vectorized
+        below-threshold prefilter against the chunk-entry threshold is a
+        complete candidate superset; and the retained set is insertion-order
+        independent (it is "the k smallest distinct scrambled hashes so
+        far"), so candidates may be processed hash-ascending rather than in
+        arrival order.  Each chunk: prefilter, dedup (a value determines its
+        hash, so ``np.unique`` on values dedups hash-consistently), drop
+        existing members, then insert hash-ascending with an early break at
+        the live threshold.  Chunks grow geometrically: as the threshold
+        tightens, ever-larger spans are disposed of by one array compare."""
+        hashes = scramble64_array(arr, self._salts)
+        n = arr.shape[0]
+        off = 0
+        # fill phase: per-element until the heap holds k distinct values
+        while len(self._heap) < self._k and off < n:
+            self._count += 1
+            self._insert(int(arr[off]), int(hashes[off]))
+            off += 1
+        chunk = 4 * self._k
+        member_arr: Optional[np.ndarray] = None
+        while off < n:
+            end = min(off + chunk, n)
+            self._count += end - off
+            cand = np.nonzero(
+                hashes[off:end] < np.uint64(self._max_hash)
+            )[0]
+            if cand.size:
+                uvals, first = np.unique(arr[off:end][cand], return_index=True)
+                uhash = hashes[off:end][cand][first]
+                if member_arr is None:
+                    member_arr = self._member_array(arr.dtype)
+                    if member_arr is None:
+                        # a member doesn't fit arr.dtype (e.g. a negative
+                        # int sampled before a uint64 stream): finish this
+                        # call on the exact per-element route
+                        self._count -= end - off  # sample() re-counts
+                        for j in range(off, n):
+                            self.sample(int(arr[j]))
+                        return
+                fresh = ~np.isin(uvals, member_arr)
+                uvals, uhash = uvals[fresh], uhash[fresh]
+                order = np.argsort(uhash)
+                changed = False
+                for i in order:
+                    h = int(uhash[i])
+                    if h >= self._max_hash:
+                        break  # hash-ascending: the rest can't be accepted
+                    self._insert(int(uvals[i]), h)
+                    changed = True
+                if changed:
+                    member_arr = self._member_array(arr.dtype)
+            off = end
+            chunk = min(chunk * 2, 1 << 20)
+
+    def _member_array(self, dtype: np.dtype) -> Optional[np.ndarray]:
+        """The membership set as a ``dtype`` array, or None when some member
+        is not representable in ``dtype`` (caller must take the per-element
+        route — ``np.isin`` against a lossy conversion would be wrong).
+
+        Range-checks explicitly: ``np.fromiter`` raises for out-of-range
+        Python ints but silently *wraps* numpy scalars (e.g. ``np.int64(-5)``
+        into uint64), which would corrupt the dedup."""
+        info = np.iinfo(dtype)
+        out = np.empty(len(self._members), dtype=dtype)
+        for i, v in enumerate(self._members):
+            iv = int(v)
+            if iv < info.min or iv > info.max:
+                return None
+            out[i] = iv
+        return out
+
+    def _insert(self, value: Any, h: int) -> None:
+        """Heap/membership insert of a pre-scrambled (value, hash) pair —
+        the tail of :meth:`sample` after the threshold compare."""
         if len(self._heap) < self._k:
             if value not in self._members:
                 self._tie += 1
@@ -109,10 +213,6 @@ class BottomKOracle:
             self._members.discard(evicted)
             self._members.add(value)
             self._max_hash = -self._heap[0][0]
-
-    def sample_all(self, elements: Iterable[Any]) -> None:
-        for element in elements:
-            self.sample(element)
 
     def result(self) -> List[Any]:
         """The sampled distinct values.  Order is not specified by the

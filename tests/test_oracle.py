@@ -238,3 +238,86 @@ def test_distinct_map_applied_every_element():
     s = BottomKOracle(4, make_rng(5), map_fn=mapper)
     s.sample_all(range(50))
     assert len(calls) == 50  # map feeds the hash (Sampler.scala:395)
+
+
+def test_scramble_scalar_array_bit_identical():
+    # the pure-Python-int scalar scramble and the vectorized array scramble
+    # must agree bit-for-bit (they back the per-element and bulk paths)
+    from reservoir_tpu.ops.hashing import draw_salts, scramble64_array
+
+    rng = np.random.default_rng(77)
+    salts = draw_salts(rng)
+    vals = rng.integers(-(2**63), 2**63 - 1, 500, dtype=np.int64)
+    arr_h = scramble64_array(vals, salts)
+    for i in range(vals.shape[0]):
+        assert int(arr_h[i]) == scramble64_int(int(vals[i]), salts)
+
+
+def test_distinct_bulk_fast_path_matches_per_element():
+    # the chunked vectorized sample_all must be indistinguishable from n
+    # per-element calls (the sample == sampleAll contract,
+    # SamplerTest.scala:117-142) across stream shapes that stress the
+    # fill boundary, heavy duplication, and negative values
+    from reservoir_tpu.ops.hashing import draw_salts
+
+    rng = np.random.default_rng(13)
+    salts = draw_salts(rng)
+    streams = [
+        rng.integers(0, 50_000, 20_000, dtype=np.int64),   # mostly unique
+        rng.integers(0, 60, 20_000, dtype=np.int64),       # heavy dup
+        rng.integers(-500, 500, 5_000, dtype=np.int64),    # negatives
+        np.arange(40, dtype=np.int64),                     # under-fill
+    ]
+    for stream in streams:
+        bulk = BottomKOracle(128, make_rng(0), salts=salts)
+        bulk.sample_all(stream)
+        scalar = BottomKOracle(128, make_rng(0), salts=salts)
+        for x in stream:
+            scalar.sample(int(x))
+        assert [int(v) for v in bulk.result()] == [
+            int(v) for v in scalar.result()
+        ]
+        assert bulk.count == scalar.count
+
+
+def test_distinct_bulk_after_mixed_type_elements_falls_back():
+    # a str element poisons the members set for the numpy round-trip; the
+    # bulk path must detect this and stay on the per-element route
+    s = BottomKOracle(8, make_rng(1))
+    s.sample("hello")
+    s.sample_all(np.arange(100, dtype=np.int64))
+    assert s.count == 101
+    assert len(s.result()) == 8
+
+
+def test_distinct_bulk_out_of_dtype_member_falls_back():
+    # members that don't fit the incoming array's dtype must reroute the
+    # bulk call to the exact per-element path, not crash np.fromiter
+    s = BottomKOracle(8, make_rng(2))
+    s.sample(-5)
+    s.sample_all(np.arange(100, dtype=np.uint64))
+    assert s.count == 101
+    s2 = BottomKOracle(8, make_rng(2))
+    s2.sample(2**63)
+    s2.sample_all(np.arange(100, dtype=np.int64))
+    assert s2.count == 101
+
+
+def test_distinct_bulk_numpy_scalar_member_wrap_guard():
+    # np.fromiter silently WRAPS out-of-range numpy scalars (np.int64(-5)
+    # -> 2**64-5 as uint64); the member-array guard must range-check, not
+    # rely on fromiter raising, or bulk dedup corrupts (r2 review finding)
+    from reservoir_tpu.ops.hashing import draw_salts
+
+    salts = draw_salts(np.random.default_rng(3))
+    stream = np.array([2**64 - 5, 1, 2, 3, 4, 5, 6, 7, 8, 9], dtype=np.uint64)
+    bulk = BottomKOracle(8, make_rng(0), salts=salts)
+    bulk.sample(np.int64(-5))
+    bulk.sample_all(stream)
+    scalar = BottomKOracle(8, make_rng(0), salts=salts)
+    scalar.sample(np.int64(-5))
+    for x in stream:
+        scalar.sample(x)
+    assert sorted(int(v) & (2**64 - 1) for v in bulk.result()) == sorted(
+        int(v) & (2**64 - 1) for v in scalar.result()
+    )
