@@ -27,11 +27,12 @@ Env knobs:
                                 (bridge = host-feed: interleaved demux ->
                                 staging -> device flushes, SURVEY §7.3's
                                 "actual likely bottleneck")
-  RESERVOIR_BENCH_IMPL          auto (default) | xla | pallas   (algl only;
-                                auto tries the Pallas kernel and falls back
-                                to the XLA path if Mosaic compile/run fails,
-                                so the headline number is the best impl but
-                                a lowering regression can't erase a round)
+  RESERVOIR_BENCH_IMPL          auto (default) | xla | pallas   (algl and
+                                weighted; auto tries the Pallas kernel on
+                                TPU and falls back to the XLA path if
+                                Mosaic compile/run fails, so the recorded
+                                number is the best impl but a lowering
+                                regression can't erase a round)
   RESERVOIR_BENCH_PLATFORM=cpu  force the CPU backend (config.update — the
                                 JAX_PLATFORMS env var belongs to the axon
                                 sitecustomize and must not be overridden)
@@ -235,8 +236,18 @@ def _bench_distinct(R, k, B, steps, reps):
     return _timed(run, state, steps, reps)
 
 
-def _bench_weighted(R, k, B, steps, reps):
+def _bench_weighted(R, k, B, steps, reps, impl="xla"):
     from reservoir_tpu.ops import weighted as ww
+
+    if impl == "pallas":
+        from reservoir_tpu.ops import weighted_pallas as wp
+
+        step_fn = functools.partial(
+            wp.update_pallas,
+            interpret=jax.default_backend() == "cpu",
+        )
+    else:
+        step_fn = ww.update
 
     @functools.partial(jax.jit, donate_argnums=0)
     def run(state, step0):
@@ -244,7 +255,7 @@ def _bench_weighted(R, k, B, steps, reps):
             base = ((step0 + s) * B).astype(jnp.int32)
             batch = base + jax.lax.broadcasted_iota(jnp.int32, (R, B), 1)
             weights = 1.0 + 0.5 * jnp.cos(batch.astype(jnp.float32) * 1e-3) ** 2
-            return ww.update(state, batch, weights), None
+            return step_fn(state, batch, weights), None
 
         state, _ = jax.lax.scan(body, state, jnp.arange(steps, dtype=jnp.int32))
         return state
@@ -285,33 +296,39 @@ def main() -> None:
     from reservoir_tpu.utils.tracing import maybe_profile
 
     with maybe_profile():  # RESERVOIR_TPU_TRACE_DIR=... captures a trace
-        if config == "algl":
+        def _run_with_impl(bench_fn, prefix):
+            """Impl selection shared by the Pallas-capable configs: auto
+            tries the kernel on TPU and falls back to XLA on any Mosaic
+            regression (one noisy lowering bug must not erase a round)."""
             if impl == "auto" and jax.default_backend() != "tpu":
-                # Mosaic lowers on TPU only; the CPU interpreter "works" but
-                # is far slower than XLA — auto must never benchmark it
-                times = _bench_algl(R, k, B, steps, reps, "xla")
-                tag = "algl_xla"
-            elif impl == "auto":
+                # Mosaic lowers on TPU only; the CPU interpreter "works"
+                # but is far slower than XLA — auto must never bench it
+                return bench_fn(R, k, B, steps, reps, "xla"), f"{prefix}_xla"
+            if impl == "auto":
                 try:
-                    times = _bench_algl(R, k, B, steps, reps, "pallas")
-                    tag = "algl_pallas"
+                    return (
+                        bench_fn(R, k, B, steps, reps, "pallas"),
+                        f"{prefix}_pallas",
+                    )
                 except Exception as e:  # Mosaic lowering/runtime regression
                     print(
-                        f"bench: pallas impl failed ({type(e).__name__}: {e}); "
-                        "falling back to xla",
+                        f"bench: {prefix} pallas failed ({type(e).__name__}: "
+                        f"{e}); falling back to xla",
                         file=sys.stderr,
                     )
-                    times = _bench_algl(R, k, B, steps, reps, "xla")
-                    tag = "algl_xla"
-            else:
-                times = _bench_algl(R, k, B, steps, reps, impl)
-                tag = f"algl_{impl}"
+                    return (
+                        bench_fn(R, k, B, steps, reps, "xla"),
+                        f"{prefix}_xla",
+                    )
+            return bench_fn(R, k, B, steps, reps, impl), f"{prefix}_{impl}"
+
+        if config == "algl":
+            times, tag = _run_with_impl(_bench_algl, "algl")
         elif config == "distinct":
             times = _bench_distinct(R, k, B, steps, reps)
             tag = "distinct"
         elif config == "weighted":
-            times = _bench_weighted(R, k, B, steps, reps)
-            tag = "weighted"
+            times, tag = _run_with_impl(_bench_weighted, "weighted")
         else:
             times = _bench_bridge(R, k, B, steps, reps)
             tag = "bridge_host_feed"

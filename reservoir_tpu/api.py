@@ -16,10 +16,12 @@ Single-use semantics: ``result()`` closes the sampler and frees its buffers
 (GC-nulling, ``:345-350``); any later ``sample``/``sample_all``/``result``
 raises :class:`~reservoir_tpu.errors.SamplerClosedError`
 (``SingleUse.checkOpen``, ``:185-186``); ``is_open`` stays callable (``:193``).
-Reusable semantics: ``result()`` returns an independent snapshot and sampling
-may continue; earlier snapshots are never clobbered (the reference guarantees
-this with copy-on-write aliasing, ``:357-379`` — here snapshots are plain
-copies, observably identical).
+Reusable semantics: ``result()`` returns a stable snapshot and sampling may
+continue; earlier snapshots are never clobbered.  As in the reference
+(zero-copy ``ArraySeq`` over the live array with copy-on-write,
+``:353-381``), the snapshot is an immutable zero-copy view
+(:class:`SampleView`) of the live buffer; the engine copies before its next
+mutation, so the view never changes underneath the caller.
 
 These host samplers run the CPU oracles — they are the semantic baseline
 (BASELINE.md config 1).  The batch/device counterpart with the same lifecycle
@@ -32,7 +34,8 @@ Samplers are NOT thread-safe, matching the reference's documented contract
 from __future__ import annotations
 
 import abc
-from typing import Any, Callable, Iterable, List, Optional, Tuple, Union
+from collections.abc import Sequence as _SequenceABC
+from typing import Any, Callable, Iterable, List, Optional, Sequence, Tuple, Union
 
 import numpy as np
 
@@ -41,9 +44,52 @@ from .errors import SamplerClosedError
 from .oracle.algorithm_l import AlgorithmLOracle
 from .oracle.bottom_k import BottomKOracle
 
-__all__ = ["Sampler", "sampler", "distinct", "weighted", "WeightedSampler"]
+__all__ = [
+    "Sampler",
+    "SampleView",
+    "sampler",
+    "distinct",
+    "weighted",
+    "WeightedSampler",
+]
 
 _identity = lambda x: x  # noqa: E731
+
+
+class SampleView(_SequenceABC):
+    """Immutable zero-copy view of a reusable sampler's current sample —
+    the ``ArraySeq.unsafeWrapArray`` analog (``Sampler.scala:375-379``).
+
+    O(1) to create: wraps the engine's live buffer without copying.  The
+    engine's copy-on-write guard copies *its* side before the next mutation,
+    so a view is a stable snapshot; immutability here keeps the caller from
+    mutating engine state through the alias (the reference returns an
+    immutable ``IndexedSeq`` for exactly this reason).
+    """
+
+    __slots__ = ("_data",)
+
+    def __init__(self, data: List[Any]) -> None:
+        self._data = data
+
+    def __getitem__(self, index):
+        if isinstance(index, slice):
+            return list(self._data[index])
+        return self._data[index]
+
+    def __len__(self) -> int:
+        return len(self._data)
+
+    def __eq__(self, other) -> bool:
+        if isinstance(other, (SampleView, list, tuple)):
+            return list(self) == list(other)
+        return NotImplemented
+
+    def __hash__(self):
+        return hash(tuple(self._data))
+
+    def __repr__(self) -> str:
+        return f"SampleView({self._data!r})"
 
 
 class Sampler(abc.ABC):
@@ -65,9 +111,10 @@ class Sampler(abc.ABC):
             self.sample(element)
 
     @abc.abstractmethod
-    def result(self) -> List[Any]:
+    def result(self) -> Sequence[Any]:
         """The sampled elements (``Sampler.scala:60``).  Single-use samplers
-        close; reusable samplers snapshot."""
+        close and return a fresh list; reusable samplers return a stable
+        snapshot (possibly an immutable zero-copy :class:`SampleView`)."""
 
     @property
     @abc.abstractmethod
@@ -130,8 +177,14 @@ class _ReusableSampler(Sampler):
     def sample_all(self, elements: Iterable[Any]) -> None:
         self._engine.sample_all(elements)
 
-    def result(self) -> List[Any]:
-        return self._engine.result()  # oracles return fresh lists: snapshot
+    def result(self) -> Sequence[Any]:
+        # zero-copy with copy-on-write when the engine supports it (the
+        # reusable aliasing optimization, Sampler.scala:353-381); the
+        # immutable view is a stable snapshot
+        view = getattr(self._engine, "result_view", None)
+        if view is not None:
+            return SampleView(view())
+        return self._engine.result()
 
     @property
     def is_open(self) -> bool:

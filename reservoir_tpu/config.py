@@ -98,13 +98,15 @@ class SamplerConfig:
       weighted: A-ExpJ weighted mode (capability beyond the reference).
       mesh_axis: mesh axis name the reservoir dimension is sharded over
         (None = single device).
-      impl: steady-state kernel selection.  ``"auto"`` (default) dispatches
-        eligible updates (steady state, full tiles, identity map, supported
-        dtypes, R divisible by the row block) to the Pallas TPU kernel on
-        TPU backends and the XLA path everywhere else; ``"xla"`` never uses
-        Pallas; ``"pallas"`` forces the Pallas kernel for eligible updates
-        (Mosaic interpreter on CPU) and fails construction if the config can
-        never be eligible.
+      impl: hot-path kernel selection.  ``"auto"`` (default) dispatches
+        eligible updates (full tiles, identity map, supported dtypes, R
+        divisible by the row block; duplicates mode additionally requires
+        steady state — the weighted kernel is fill-capable) to the Pallas
+        TPU kernels on TPU backends and the XLA path everywhere else;
+        ``"xla"`` never uses Pallas; ``"pallas"`` forces the Pallas kernel
+        for eligible updates (Mosaic interpreter on CPU) and fails
+        construction if the config can never be eligible.  Distinct mode
+        has no Pallas kernel (sort-based merge) and always takes XLA.
     """
 
     max_sample_size: int

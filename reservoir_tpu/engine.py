@@ -96,25 +96,27 @@ class ReservoirEngine:
         )
         if config.impl == "pallas":
             # Fail construction, not first sample, if this config can never
-            # reach the kernel (the "fail fast" validation philosophy of
-            # ``Sampler.scala:79-95``).  The fill phase and ragged tiles
-            # still use the XLA path — the kernel is steady-state-only.
-            from .ops import algorithm_l_pallas as _alp
-
-            if self._ops is not _algl:
-                raise ValueError("impl='pallas' requires duplicates mode")
+            # reach a kernel (the "fail fast" validation philosophy of
+            # ``Sampler.scala:79-95``).  Duplicates mode: the Algorithm-L
+            # kernel is steady-state-only (fill/ragged tiles use XLA);
+            # weighted mode: the A-ExpJ kernel is fill-capable.
+            if self._ops is _distinct:
+                raise ValueError(
+                    "impl='pallas' has no distinct-mode kernel (sort-based "
+                    "merge is the XLA path); use impl='auto'"
+                )
             if map_fn is not None:
                 raise ValueError("impl='pallas' requires an identity map_fn")
-            if config.num_reservoirs % _alp._DEFAULT_BLOCK_R != 0:
+            block_r = self._pallas_module()._DEFAULT_BLOCK_R
+            if config.num_reservoirs % block_r != 0:
                 raise ValueError(
                     "impl='pallas' requires num_reservoirs divisible by "
-                    f"{_alp._DEFAULT_BLOCK_R}, got {config.num_reservoirs}"
+                    f"{block_r}, got {config.num_reservoirs}"
                 )
-            if config.mesh_axis is not None:
-                raise ValueError(
-                    "impl='pallas' under a sharded mesh is not supported yet; "
-                    "use impl='auto' (XLA SPMD path)"
-                )
+            # mesh_axis is fine: the kernel is collective-free over the
+            # reservoir grid, so it runs under shard_map with each chip
+            # taking its row-blocks; per-shard divisibility is checked after
+            # the mesh is built below
         # Multi-chip placement (SamplerConfig.mesh_axis makes the mesh real,
         # VERDICT r1 item 4): state shards over the reservoir axis and every
         # incoming tile is device_put with the matching sharding, so the
@@ -135,6 +137,15 @@ class ReservoirEngine:
                     f"evenly over the {n_shards}-device '{config.mesh_axis}' "
                     "mesh axis"
                 )
+            if config.impl == "pallas":
+                block_r = self._pallas_module()._DEFAULT_BLOCK_R
+                if (config.num_reservoirs // n_shards) % block_r != 0:
+                    raise ValueError(
+                        "impl='pallas' on this mesh needs "
+                        f"num_reservoirs/{n_shards} divisible by "
+                        f"{block_r}, got "
+                        f"{config.num_reservoirs // n_shards}"
+                    )
             self._tile_sharding = jax.sharding.NamedSharding(
                 self._mesh, jax.sharding.PartitionSpec(config.mesh_axis, None)
             )
@@ -206,26 +217,43 @@ class ReservoirEngine:
 
     # -------------------------------------------------------------- sampling
 
+    def _pallas_module(self):
+        """The Pallas kernel module for this mode, or None (distinct)."""
+        if self._ops is _algl:
+            from .ops import algorithm_l_pallas as _alp
+
+            return _alp
+        if self._ops is _weighted:
+            from .ops import weighted_pallas as _wp
+
+            return _wp
+        return None
+
     def _pallas_eligible(self, steady: bool, ragged: bool, tile_dtype) -> bool:
-        """Dispatch gate for the M4 Pallas kernel (VERDICT r1 item 2): the
-        steady-state hot path goes through Mosaic when the kernel's
-        ``supports()`` contract holds; everything else falls back to XLA."""
+        """Dispatch gate for the Pallas kernels (VERDICT r1 item 2): the
+        hot path goes through Mosaic when the kernel's ``supports()``
+        contract holds; everything else falls back to XLA.  Duplicates mode
+        requires steady state (the M4 kernel has no fill scatter); the
+        weighted M4b kernel is fill-capable."""
         if self._config.impl == "xla":
             return False
-        if (
-            not steady
-            or ragged
-            or self._ops is not _algl
-            or self._map_fn is not None
-            or self._mesh is not None  # Pallas-under-shard_map: future work
-        ):
+        if ragged or self._map_fn is not None:
             return False
-        from .ops import algorithm_l_pallas as _alp
-
-        if not _alp.supports(self._state, None, None) or (
+        mod = self._pallas_module()
+        if mod is None or (self._ops is _algl and not steady):
+            return False
+        if not mod.supports(self._state, None, None) or (
             jnp.dtype(tile_dtype) != self._state.samples.dtype
         ):
             return False
+        if self._mesh is not None:
+            # under shard_map each chip runs the kernel on its own
+            # row-blocks; the per-shard reservoir count must still tile
+            n_shards = self._mesh.shape[self._config.mesh_axis]
+            if (
+                self._config.num_reservoirs // n_shards
+            ) % mod._DEFAULT_BLOCK_R != 0:
+                return False
         if self._config.impl == "pallas":
             return True
         # auto: Mosaic lowers on TPU only — GPU/CPU backends take the XLA
@@ -238,12 +266,39 @@ class ReservoirEngine:
         fn = self._jit_cache.get(cache_key)
         if fn is None:
             if use_pallas:
-                from .ops import algorithm_l_pallas as _alp
-
-                base = functools.partial(
-                    _alp.update_steady_pallas,
-                    interpret=jax.default_backend() == "cpu",
+                mod = self._pallas_module()
+                kernel = (
+                    mod.update_steady_pallas
+                    if self._ops is _algl
+                    else mod.update_pallas
                 )
+                base = functools.partial(
+                    kernel, interpret=jax.default_backend() == "cpu"
+                )
+                if self._mesh is not None:
+                    # pallas_call is not auto-partitionable — run it under
+                    # shard_map so each chip takes its reservoir row-blocks
+                    # (the kernel is collective-free over the grid)
+                    from jax.sharding import PartitionSpec as _P
+
+                    axis = self._config.mesh_axis
+                    specs = jax.tree.map(
+                        lambda x: _P(axis, *([None] * (x.ndim - 1))),
+                        self._state,
+                    )
+                    tile_specs = (_P(axis, None),) * (
+                        2 if self._config.weighted else 1
+                    )
+                    base = jax.shard_map(
+                        base,
+                        mesh=self._mesh,
+                        in_specs=(specs,) + tile_specs,
+                        out_specs=specs,
+                        # pallas_call out_shapes carry no varying-mesh-axes
+                        # info; the kernel is collective-free over the grid,
+                        # so the vma check adds nothing here
+                        check_vma=False,
+                    )
             else:
                 base = self._ops.update_steady if steady else self._ops.update
                 kwargs = {"map_fn": self._map_fn}

@@ -1,0 +1,297 @@
+"""Pallas TPU kernel for weighted A-ExpJ tile updates (M4b).
+
+Same motivation as the Algorithm-L kernel (:mod:`.algorithm_l_pallas`): the
+XLA vmap path carries ``samples [R, k]`` + ``lkeys [R, k]`` through a batched
+``while_loop``, paying a full per-lane carry select (~5 × R × k × 4 bytes of
+HBM traffic) per acceptance round.  Here the reservoir block lives in VMEM
+for the whole tile and acceptances mutate it in place.
+
+Unlike the Algorithm-L kernel this one is **fill-capable**: weighted fill
+cannot be proven over from a host-side element count (zero-weight items
+advance ``count`` without taking a slot — the zero-weight contract of
+:mod:`.weighted`), so the engine can never dispatch a steady-only weighted
+kernel safely.  The fill scatter is a k-step in-VMEM loop instead.
+
+Bit-equivalence with :func:`reservoir_tpu.ops.weighted.update` on full tiles
+is by construction: both paths consume the same counter-keyed Threefry
+channels (``rng.uniforms(key, idx, (3,))`` — fill key, conditional key, jump
+draw) at the same absolute indices, and every float op (cumsum partial sums,
+``log``/``exp`` chain, f32-min clamps) is the same trace.  Pinned in
+interpret mode by ``tests/test_pallas_weighted.py``.
+
+Scope (engine dispatch via :func:`supports`): full tiles (no ``valid``),
+identity ``map_fn``, int32 counters, int32/float32/uint32 samples, float32
+weights, R divisible by the row-block size.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .rng import key_words, uniform_from_bits
+from .threefry import counter_bits
+from .weighted import WeightedState, _NEG_INF, _draw_xw
+
+__all__ = ["supports", "update_pallas"]
+
+_DEFAULT_BLOCK_R = 64
+_F32_MIN = float(jnp.finfo(jnp.float32).min)
+
+
+def supports(
+    state: WeightedState,
+    valid,
+    map_fn,
+    block_r: int = _DEFAULT_BLOCK_R,
+    batch: "jax.Array | None" = None,
+) -> bool:
+    """True iff this kernel can take the tile (else: XLA path)."""
+    return (
+        valid is None
+        and map_fn is None
+        and state.count.dtype == jnp.int32
+        and state.samples.dtype in (jnp.int32, jnp.float32, jnp.uint32)
+        and (batch is None or batch.dtype == state.samples.dtype)
+        and state.samples.shape[0] % block_r == 0
+    )
+
+
+def _row_gather_bits(onehot, value_bits):
+    """Exact one-hot row gather: sum of int32 bit patterns (cf. the
+    Algorithm-L kernel's gather — a float sum would drop -0.0 sign bits)."""
+    return jnp.sum(jnp.where(onehot, value_bits, 0), axis=1, keepdims=True)
+
+
+def _kernel(
+    samples_ref,
+    lkeys_ref,
+    count_ref,
+    xw_ref,
+    key_ref,
+    elems_ref,
+    weights_ref,
+    out_samples_ref,
+    out_lkeys_ref,
+    out_xw_ref,
+    *,
+    k: int,
+    block_b: int,
+):
+    """One grid cell = one ``[block_r]`` row-block of reservoirs × one tile.
+
+    Mirrors ``weighted._update_one`` (fill=True, full tile) exactly, with
+    per-reservoir scalars as ``[block_r, 1]`` columns and the membership
+    scatter/gathers as one-hot masked reductions.
+    """
+    count = count_ref[:, :]  # [r, 1] int32 (pre-tile count)
+    k1 = key_ref[:, 0:1]
+    k2 = key_ref[:, 1:2]
+    block_r = count.shape[0]
+
+    lane_b = jax.lax.broadcasted_iota(jnp.int32, (block_r, block_b), 1)
+    lane_k = jax.lax.broadcasted_iota(jnp.int32, (block_r, k), 1)
+
+    wf = weights_ref[:, :]  # [r, B] f32
+    positive = wf > 0.0
+    cw = jnp.cumsum(wf, axis=1)  # [r, B]
+    total_w = cw[:, block_b - 1 : block_b]  # [r, 1]
+    n_filled = jnp.sum(
+        (lkeys_ref[:, :] > _NEG_INF).astype(jnp.int32), axis=1, keepdims=True
+    )
+    need = jnp.maximum(k - n_filled, 0)  # [r, 1]
+    prank = jnp.cumsum(positive.astype(jnp.int32), axis=1)  # [r, B]
+    idx_abs = count + lane_b + 1  # [r, B] absolute 1-based
+
+    # ---- fill phase (positive items take the next free slots in order) ----
+    w0_fill, _, _ = counter_bits(k1, k2, idx_abs, 3)
+    u_fill = uniform_from_bits(w0_fill)
+    lk_fill = jnp.where(
+        positive,
+        jnp.log(u_fill) / jnp.maximum(wf, jnp.float32(1e-45)),
+        _NEG_INF,
+    )
+    lk_fill = jnp.maximum(lk_fill, jnp.float32(_F32_MIN))
+    fill_mask = positive & (prank <= need)
+    dest = jnp.where(fill_mask, n_filled + prank - 1, k)  # k -> dropped
+
+    out_samples_ref[:, :] = samples_ref[:, :]
+    out_lkeys_ref[:, :] = lkeys_ref[:, :]
+    elem_bits_all = jax.lax.bitcast_convert_type(elems_ref[:, :], jnp.int32)
+    lk_bits_all = jax.lax.bitcast_convert_type(lk_fill, jnp.int32)
+
+    def fill_slot(s, _):
+        col = dest == s  # [r, B]; at most one lane per row
+        wrote = jnp.any(col, axis=1, keepdims=True)  # [r, 1]
+        e_bits = _row_gather_bits(col, elem_bits_all)
+        l_bits = _row_gather_bits(col, lk_bits_all)
+        slot_mask = (lane_k == s) & wrote
+        out_samples_ref[:, :] = jnp.where(
+            slot_mask,
+            jax.lax.bitcast_convert_type(
+                e_bits, out_samples_ref.dtype
+            ),
+            out_samples_ref[:, :],
+        )
+        out_lkeys_ref[:, :] = jnp.where(
+            slot_mask,
+            jax.lax.bitcast_convert_type(l_bits, jnp.float32),
+            out_lkeys_ref[:, :],
+        )
+        return 0
+
+    jax.lax.fori_loop(0, k, fill_slot, 0)
+
+    # fill completing inside this tile draws the first jump, keyed on index k
+    n_pos = prank[:, block_b - 1 : block_b]
+    completes = (n_filled < k) & (n_filled + n_pos >= k)
+    _, _, w2_init = counter_bits(
+        k1, k2, jnp.full_like(count, k), 3
+    )
+    u3_init = uniform_from_bits(w2_init)
+    min_lk = jnp.min(out_lkeys_ref[:, :], axis=1, keepdims=True)
+    xw = jnp.where(completes, _draw_xw(u3_init, min_lk), xw_ref[:, :])
+
+    # ---- acceptance scan (weighted._update_one's while_loop) --------------
+    j0 = jnp.sum(
+        (prank < need).astype(jnp.int32), axis=1, keepdims=True
+    )  # searchsorted(prank, need, 'left')
+    start = jnp.where(need > 0, jnp.minimum(j0 + 1, block_b), 0)
+    cw_bits = jax.lax.bitcast_convert_type(cw, jnp.int32)
+    base0_bits = _row_gather_bits(lane_b == (start - 1), cw_bits)
+    base0 = jnp.where(
+        start > 0, jax.lax.bitcast_convert_type(base0_bits, jnp.float32), 0.0
+    )
+
+    def next_j(base, xw_c, cur):
+        x = base + xw_c  # [r, 1]
+        j = jnp.sum((cw < x).astype(jnp.int32), axis=1, keepdims=True)
+        return jnp.maximum(j, cur)
+
+    def cond(carry):
+        xw_c, base, cur = carry
+        return jnp.any(next_j(base, xw_c, cur) < block_b)
+
+    def body(carry):
+        xw_c, base, cur = carry
+        j = next_j(base, xw_c, cur)  # [r, 1]
+        active = j < block_b
+        onehot_j = lane_b == j  # empty when j == block_b
+        w_c = jnp.sum(jnp.where(onehot_j, wf, 0.0), axis=1, keepdims=True)
+        # the crossing item always has w > 0 (flat cumsum spans can't be
+        # crossed), so active lanes use the raw weight — bit-identical to
+        # the XLA path even for subnormal weights; inactive lanes get 1.0
+        # purely to avoid masked NaNs that would trip jax_debug_nans
+        w_safe = jnp.where(active, w_c, 1.0)
+        e_bits = _row_gather_bits(onehot_j, elem_bits_all)
+        idx = count + 1 + j
+        _, w1_a, w2_a = counter_bits(k1, k2, idx, 3)
+        u1 = uniform_from_bits(w1_a)
+        u2 = uniform_from_bits(w2_a)
+        lkeys_c = out_lkeys_ref[:, :]
+        lt = jnp.min(lkeys_c, axis=1, keepdims=True)
+        lt_safe = jnp.where(active, lt, 0.0)
+        t = jnp.exp(w_safe * lt_safe)
+        r2 = t + u1 * (1.0 - t)
+        lkey_new = jnp.maximum(
+            jnp.log(r2) / w_safe, jnp.float32(_F32_MIN)
+        )
+        # argmin with first-match tie-breaking (jnp.argmin semantics)
+        is_min = lkeys_c == lt
+        first_min = is_min & (jnp.cumsum(is_min.astype(jnp.int32), axis=1) == 1)
+        write = first_min & active
+        out_samples_ref[:, :] = jnp.where(
+            write,
+            jax.lax.bitcast_convert_type(e_bits, out_samples_ref.dtype),
+            out_samples_ref[:, :],
+        )
+        out_lkeys_ref[:, :] = jnp.where(write, lkey_new, out_lkeys_ref[:, :])
+        min_after = jnp.min(out_lkeys_ref[:, :], axis=1, keepdims=True)
+        xw_n = _draw_xw(u2, min_after)
+        base_j_bits = _row_gather_bits(onehot_j, cw_bits)
+        base_j = jax.lax.bitcast_convert_type(base_j_bits, jnp.float32)
+        return (
+            jnp.where(active, xw_n, xw_c),
+            jnp.where(active, base_j, base),
+            jnp.where(active, j + 1, cur),
+        )
+
+    xw, base, _cur = jax.lax.while_loop(cond, body, (xw, base0, start))
+    # carry the unconsumed jump across the tile boundary
+    out_xw_ref[:, :] = xw - (total_w - base)
+
+
+def update_pallas(
+    state: WeightedState,
+    elems: jax.Array,
+    weights: jax.Array,
+    *,
+    block_r: int = _DEFAULT_BLOCK_R,
+    interpret: bool = False,
+) -> WeightedState:
+    """Full-tile weighted update, bit-identical to
+    :func:`reservoir_tpu.ops.weighted.update` on full tiles.
+
+    ``elems``/``weights`` are ``[R, B]``; requires :func:`supports`.
+    ``interpret=True`` runs the Mosaic interpreter (CPU equivalence tests).
+    """
+    R, k = state.samples.shape
+    B = elems.shape[1]
+    if elems.shape[0] != R or weights.shape != elems.shape:
+        raise ValueError(
+            f"elems {elems.shape} / weights {weights.shape} must be "
+            f"[{R}, B] tiles"
+        )
+    if not supports(state, None, None, block_r, elems):
+        raise ValueError(
+            "update_pallas: unsupported config (need int32 counters, "
+            f"int32/float32/uint32 samples, elems dtype == samples dtype, "
+            f"R % {block_r} == 0); use ops.weighted.update"
+        )
+    kd1, kd2 = key_words(state.key)  # [R] uint32 each
+    key_data = jnp.stack([kd1, kd2], axis=1)  # [R, 2]
+
+    col = lambda i: (i, 0)  # noqa: E731 — row-block i, full second axis
+    col_spec = lambda w: pl.BlockSpec(  # noqa: E731
+        (block_r, w), col, memory_space=pltpu.VMEM
+    )
+
+    out_samples, out_lkeys, out_xw = pl.pallas_call(
+        functools.partial(_kernel, k=k, block_b=B),
+        grid=(R // block_r,),
+        in_specs=[
+            col_spec(k),
+            col_spec(k),
+            col_spec(1),
+            col_spec(1),
+            col_spec(2),
+            col_spec(B),
+            col_spec(B),
+        ],
+        out_specs=(col_spec(k), col_spec(k), col_spec(1)),
+        out_shape=(
+            jax.ShapeDtypeStruct((R, k), state.samples.dtype),
+            jax.ShapeDtypeStruct((R, k), jnp.float32),
+            jax.ShapeDtypeStruct((R, 1), jnp.float32),
+        ),
+        interpret=interpret,
+    )(
+        state.samples,
+        state.lkeys,
+        state.count.reshape(R, 1),
+        state.xw.reshape(R, 1),
+        key_data,
+        elems,
+        jnp.asarray(weights, jnp.float32),
+    )
+    return WeightedState(
+        samples=out_samples,
+        lkeys=out_lkeys,
+        count=state.count + jnp.asarray(B, state.count.dtype),
+        xw=out_xw.reshape(R),
+        key=state.key,
+    )

@@ -85,6 +85,7 @@ class AlgorithmLOracle:
         # arrives.  Device engines always pre-allocate (XLA static shapes).
         self._samples: List[Any] = []
         self._pre_allocate = pre_allocate
+        self._aliased = False  # a result_view() holds our live list
         self._count: int = 0
         self._log_w: float = 0.0
         self._next: int = self._k  # absolute 1-based index of next acceptance
@@ -108,12 +109,23 @@ class AlgorithmLOracle:
 
     def _evict(self, element: Any) -> None:
         """Overwrite a uniformly random slot (``Sampler.scala:243-246``)."""
+        if self._aliased:
+            self._ensure_unaliased()
         slot = int(self._rng.integers(self._k))
         self._samples[slot] = self._map(element)
         self._advance()
 
     def _append(self, element: Any) -> None:
+        if self._aliased:
+            self._ensure_unaliased()
         self._samples.append(self._map(element))
+
+    def _ensure_unaliased(self) -> None:
+        """Copy-on-write (``ensureUnaliased``, ``Sampler.scala:357-365``):
+        an outstanding :meth:`result_view` holds the live list — copy before
+        the first mutation so the view stays a stable snapshot."""
+        self._samples = list(self._samples)
+        self._aliased = False
 
     # -- public per-element / bulk API ---------------------------------------
 
@@ -195,11 +207,22 @@ class AlgorithmLOracle:
 
     def result(self) -> List[Any]:
         """Current sample; fewer than ``k`` seen -> all of them, in arrival
-        order (truncation, ``Sampler.scala:318-331``).  Returns a fresh list —
-        the reference's zero-copy/copy-on-write machinery
-        (``Sampler.scala:353-381``) is an optimization its tests treat as
-        invisible."""
+        order (truncation, ``Sampler.scala:318-331``).  Always a fresh list."""
         size = min(self._count, self._k)
+        return list(self._samples[:size])
+
+    def result_view(self) -> List[Any]:
+        """Zero-copy result with copy-on-write protection — the reusable
+        aliasing optimization of ``MultiResultRandomElements``
+        (``Sampler.scala:353-381``): when the buffer holds exactly the sample
+        (the steady-state common case), return the *live* list and mark it
+        aliased; the next mutation copies first, so the view is a stable
+        snapshot.  Callers must treat the returned list as immutable (the
+        reference returns an immutable wrapper over the live array)."""
+        size = min(self._count, self._k)
+        if size == len(self._samples):
+            self._aliased = True
+            return self._samples
         return list(self._samples[:size])
 
 

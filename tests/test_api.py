@@ -168,3 +168,28 @@ class TestWeightedHostSampler:
         s = api.weighted(3, rng=3, naive=True)
         s.sample_all((i, 1.0) for i in range(10))
         assert len(s.result()) == 3
+
+
+def test_reusable_result_aliasing_snapshot_integrity():
+    # the reusable result is zero-copy (aliasing the live buffer) but must
+    # behave as a stable snapshot: more sampling never clobbers an earlier
+    # result (copy-on-write, Sampler.scala:353-381 / SamplerTest.scala:292-316)
+    import numpy as np
+
+    from reservoir_tpu.api import sampler
+
+    s = sampler(16, reusable=True, rng=1)
+    s.sample_all(np.arange(1000, dtype=np.int64))
+    first = s.result()
+    first_copy = list(first)
+    s.sample_all(np.arange(1000, 200_000, dtype=np.int64))
+    assert list(first) == first_copy  # earlier snapshot untouched
+    second = s.result()
+    assert len(second) == 16
+    # steady state: the view wraps the live buffer itself until the next
+    # write (zero-copy), and is immutable so the alias can't corrupt state
+    assert s.result()._data is s.result()._data
+    with pytest.raises(TypeError):
+        second[0] = 123
+    with pytest.raises(AttributeError):
+        second.sort()

@@ -136,3 +136,86 @@ def test_bridge_sharded_end_to_end():
     assert len(single) == len(sharded) == R
     for a, b in zip(single, sharded):
         np.testing.assert_array_equal(a, b)
+
+
+def test_engine_sharded_pallas_bit_identical():
+    # the M4 Pallas kernel under shard_map: each device runs the kernel on
+    # its own reservoir row-blocks (collective-free grid); results must be
+    # bit-identical to the single-device kernel AND the XLA SPMD path
+    Rp, Kp, Bp = 512, 16, 64  # 64 reservoirs/shard = one kernel block each
+    tiles = [
+        np.arange(Rp * Bp, dtype=np.int32).reshape(Rp, Bp) + s * Rp * Bp
+        for s in range(3)
+    ]
+    results = []
+    for kw in (
+        dict(impl="pallas"),
+        dict(impl="pallas", mesh_axis="res"),
+        dict(mesh_axis="res"),
+    ):
+        eng = ReservoirEngine(
+            SamplerConfig(
+                max_sample_size=Kp, num_reservoirs=Rp, tile_size=Bp, **kw
+            ),
+            key=9,
+            reusable=True,
+        )
+        for t in tiles:
+            eng.sample(t)
+        results.append(eng.result_arrays())
+    (s0, z0), (s1, z1), (s2, z2) = results
+    np.testing.assert_array_equal(s0, s1)
+    np.testing.assert_array_equal(z0, z1)
+    np.testing.assert_array_equal(s0, s2)
+    np.testing.assert_array_equal(z0, z2)
+
+
+def test_engine_sharded_pallas_rejects_untileable_shard():
+    # 8 devices x block 64: R=256 gives 32 reservoirs/shard — constructor
+    # must fail fast (Sampler.scala:79-95 validation philosophy)
+    with pytest.raises(ValueError, match="divisible"):
+        ReservoirEngine(
+            SamplerConfig(
+                max_sample_size=8,
+                num_reservoirs=256,
+                tile_size=32,
+                impl="pallas",
+                mesh_axis="res",
+            ),
+            key=1,
+        )
+
+
+def test_engine_weighted_pallas_bit_identical():
+    # M4b: the fill-capable weighted kernel through the engine — XLA,
+    # single-device Pallas, and Pallas-under-shard_map must agree bit-for-bit
+    Rp, Kp, Bp = 512, 8, 64
+    rng = np.random.default_rng(4)
+    tiles = [rng.integers(0, 1 << 30, (Rp, Bp)).astype(np.int32) for _ in range(3)]
+    wts = [rng.integers(1, 5, (Rp, Bp)).astype(np.float32) for _ in range(3)]
+    wts[1][:, ::3] = 0.0  # zero-weight contract through the kernel
+    results = []
+    for kw in (
+        dict(impl="xla"),
+        dict(impl="pallas"),
+        dict(impl="pallas", mesh_axis="res"),
+    ):
+        eng = ReservoirEngine(
+            SamplerConfig(
+                max_sample_size=Kp,
+                num_reservoirs=Rp,
+                tile_size=Bp,
+                weighted=True,
+                **kw,
+            ),
+            key=9,
+            reusable=True,
+        )
+        for t, w in zip(tiles, wts):
+            eng.sample(t, weights=w)
+        results.append(eng.result_arrays())
+    (s0, z0), (s1, z1), (s2, z2) = results
+    np.testing.assert_array_equal(s0, s1)
+    np.testing.assert_array_equal(z0, z1)
+    np.testing.assert_array_equal(s0, s2)
+    np.testing.assert_array_equal(z0, z2)

@@ -1,0 +1,91 @@
+"""Weighted Pallas kernel == XLA vmap kernel, bit for bit (M4b).
+
+Same contract as ``tests/test_pallas_algl.py``: both implementations consume
+identical counter-keyed Threefry channels at the same absolute indices, so
+equality is exact when the weight partial sums are exact in float32 (integer
+-valued weights) and within float-rounding otherwise.  Runs the Mosaic
+interpreter on the CPU test mesh.
+"""
+
+import jax
+import jax.numpy as jnp
+import jax.random as jr
+import numpy as np
+import pytest
+
+from reservoir_tpu.ops import weighted as ww
+from reservoir_tpu.ops import weighted_pallas as wp
+
+
+def _int_weights(key, R, B, lo=1, hi=5):
+    # integer-valued f32 weights: cumsum partial sums are exact, so the two
+    # implementations' float paths see literally the same numbers
+    return jr.randint(key, (R, B), lo, hi).astype(jnp.float32)
+
+
+def _assert_state_equal(a, b):
+    np.testing.assert_array_equal(np.asarray(a.samples), np.asarray(b.samples))
+    np.testing.assert_array_equal(np.asarray(a.lkeys), np.asarray(b.lkeys))
+    np.testing.assert_array_equal(np.asarray(a.count), np.asarray(b.count))
+    np.testing.assert_array_equal(np.asarray(a.xw), np.asarray(b.xw))
+
+
+@pytest.mark.parametrize("R,k,B", [(8, 16, 64), (16, 8, 32), (8, 64, 128)])
+def test_weighted_pallas_matches_vmap_from_empty(R, k, B):
+    # fill phase + first acceptances inside one tile
+    state = ww.init(jr.key(0), R, k)
+    elems = jax.lax.broadcasted_iota(jnp.int32, (R, B), 1)
+    weights = _int_weights(jr.key(1), R, B)
+    ref = ww.update(state, elems, weights)
+    got = wp.update_pallas(state, elems, weights, block_r=8, interpret=True)
+    _assert_state_equal(ref, got)
+
+
+def test_weighted_pallas_zero_weight_contract():
+    # zero-weight items: counted, never sampled, flat cumsum spans skipped
+    R, k, B = 8, 8, 64
+    state = ww.init(jr.key(2), R, k)
+    elems = jax.lax.broadcasted_iota(jnp.int32, (R, B), 1)
+    weights = _int_weights(jr.key(3), R, B)
+    weights = weights * (jr.uniform(jr.key(4), (R, B)) > 0.3)  # ~30% zeros
+    ref = ww.update(state, elems, weights)
+    got = wp.update_pallas(state, elems, weights, block_r=8, interpret=True)
+    _assert_state_equal(ref, got)
+
+
+def test_weighted_pallas_multi_tile_chain():
+    # chained tiles: fill completing mid-stream, then steady acceptances
+    R, k, B = 8, 8, 32
+    s_ref = s_pal = ww.init(jr.key(5), R, k)
+    for step in range(6):
+        elems = step * B + jax.lax.broadcasted_iota(jnp.int32, (R, B), 1)
+        weights = _int_weights(jr.fold_in(jr.key(6), step), R, B)
+        s_ref = ww.update(s_ref, elems, weights)
+        s_pal = wp.update_pallas(
+            s_pal, elems, weights, block_r=8, interpret=True
+        )
+        _assert_state_equal(s_ref, s_pal)
+
+
+def test_weighted_pallas_float_weights_close():
+    # non-integer weights: cumsum association may differ between the two
+    # lowerings, so parity is within float rounding, not bit-exact
+    R, k, B = 8, 16, 64
+    state = ww.init(jr.key(7), R, k)
+    elems = jax.lax.broadcasted_iota(jnp.int32, (R, B), 1)
+    weights = 0.25 + jr.uniform(jr.key(8), (R, B))
+    ref = ww.update(state, elems, weights)
+    got = wp.update_pallas(state, elems, weights, block_r=8, interpret=True)
+    # counts always exact; sizes (filled slots) too
+    np.testing.assert_array_equal(np.asarray(ref.count), np.asarray(got.count))
+    rs, rz = ww.result(ref)
+    gs, gz = ww.result(got)
+    np.testing.assert_array_equal(np.asarray(rz), np.asarray(gz))
+
+
+def test_weighted_pallas_rejects_unsupported():
+    state = ww.init(jr.key(9), 6, 4)  # R=6 not divisible by block_r
+    elems = jnp.zeros((6, 8), jnp.int32)
+    weights = jnp.ones((6, 8), jnp.float32)
+    with pytest.raises(ValueError, match="unsupported"):
+        wp.update_pallas(state, elems, weights, block_r=8, interpret=True)
