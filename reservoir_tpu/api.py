@@ -286,9 +286,31 @@ class WeightedSampler:
         self._check_open()
         self._engine.sample(element, weight)
 
-    def sample_all(self, pairs: Iterable[Tuple[Any, float]]) -> None:
+    def sample_all(
+        self,
+        pairs: Iterable[Tuple[Any, float]],
+        weights: Optional[Any] = None,
+    ) -> None:
+        """Bulk path: ``sample_all(pairs)`` over ``(element, weight)`` pairs,
+        or ``sample_all(elements, weights)`` over parallel arrays — the
+        array form takes the vectorized exponential-jump route (identical
+        results, C-speed skips) when the engine provides it."""
         self._check_open()
-        self._engine.sample_all(pairs)
+        if weights is not None:
+            bulk = getattr(self._engine, "sample_all_arrays", None)
+            if bulk is not None:
+                bulk(pairs, weights)
+            else:
+                elems_arr = np.asarray(pairs)
+                weights_arr = np.asarray(weights)
+                if elems_arr.shape != weights_arr.shape:
+                    # zip() would silently truncate the longer side
+                    raise ValueError(
+                        "elements and weights must be matching 1-D arrays"
+                    )
+                self._engine.sample_all(zip(elems_arr, weights_arr))
+        else:
+            self._engine.sample_all(pairs)
 
     def result(self) -> List[Any]:
         self._check_open()

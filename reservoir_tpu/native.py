@@ -53,17 +53,19 @@ def load_library(rebuild: bool = False) -> Optional[ctypes.CDLL]:
         if _load_attempted and not rebuild:
             return _lib
         _load_attempted = True
-        if not os.path.exists(_SO_PATH) or rebuild:
-            try:
-                subprocess.run(
-                    ["make", "-C", _NATIVE_DIR],
-                    check=True,
-                    capture_output=True,
-                    timeout=120,
-                )
-            except (OSError, subprocess.SubprocessError) as e:
-                _load_error = f"native build failed: {e}"
-                return None
+        # always run make: a no-op when the .so is newer than the sources,
+        # a rebuild when a source file (e.g. a newly added helper) changed
+        try:
+            subprocess.run(
+                ["make", "-C", _NATIVE_DIR],
+                check=True,
+                capture_output=True,
+                timeout=120,
+            )
+        except (OSError, subprocess.SubprocessError) as e:
+            _load_error = f"native build failed: {e}"
+            if not os.path.exists(_SO_PATH):
+                return None  # no stale .so to fall back on either
         try:
             lib = ctypes.CDLL(_SO_PATH)
         except OSError as e:
@@ -104,6 +106,18 @@ def _finish_load(lib: ctypes.CDLL) -> ctypes.CDLL:
         ctypes.c_void_p,
         ctypes.c_void_p,
     ]
+    if hasattr(lib, "rsv_bottomk_scan"):  # absent only in a stale pre-r2 .so
+        lib.rsv_bottomk_scan.restype = ctypes.c_int64
+        lib.rsv_bottomk_scan.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_int64,
+            ctypes.c_uint64,
+            ctypes.c_uint64,
+            ctypes.c_void_p,
+            ctypes.c_void_p,
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.c_int32,
+        ]
     _lib = lib
     return _lib
 

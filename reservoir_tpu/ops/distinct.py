@@ -166,10 +166,14 @@ def _bottom_k_merge(pad, hhi, hlo, vhi, vlo, k: int):
     """Shared sort-dedup-truncate core of :func:`update` and :func:`merge`.
 
     One code path for narrow and wide keys: values travel as uint32
-    bit-planes, dedup groups on the full (hash, value-bits) key.  Two
-    ``lax.sort`` passes of ``len(pad)`` lanes replace the reference's
-    per-element heap ops.
+    bit-planes, dedup groups on the full (hash, value-bits) key.  One
+    ``lax.sort`` pass of ``len(pad)`` lanes replaces the reference's
+    per-element heap ops; the dedup/padding squeeze-out afterwards is a
+    *stable compaction* of an already-sorted array (survivors keep their
+    relative hash order), so it is a cumsum-rank scatter in O(n), not a
+    second O(n log n) sort.
     """
+    n = pad.shape[0]
     # sort by (pad, hash, value-bits): equal values -> equal hashes -> adjacent
     pad, hhi, hlo, vhi, vlo = jax.lax.sort(
         (pad, hhi, hlo, vhi, vlo), num_keys=5
@@ -182,19 +186,17 @@ def _bottom_k_merge(pad, hhi, hlo, vhi, vlo, k: int):
         & (vlo == jnp.roll(vlo, 1))
     )
     same_as_prev = same_as_prev.at[0].set(False)
-    drop = same_as_prev | (pad == 1)
+    keep = ~(same_as_prev | (pad == 1))
 
-    # demote duplicates and padding to canonical padding, re-sort, keep k
-    hhi = jnp.where(drop, _U32_MAX, hhi)
-    hlo = jnp.where(drop, _U32_MAX, hlo)
-    vhi = jnp.where(drop, jnp.uint32(0), vhi)
-    vlo = jnp.where(drop, jnp.uint32(0), vlo)
-    pad2 = drop.astype(jnp.uint32)
-    pad2, hhi, hlo, vhi, vlo = jax.lax.sort(
-        (pad2, hhi, hlo, vhi, vlo), num_keys=3
-    )
-    n_unique = jnp.sum(1 - pad2).astype(jnp.int32)
-    return hhi[:k], hlo[:k], vhi[:k], vlo[:k], jnp.minimum(n_unique, k)
+    # compact survivors to the front (their order is already hash-ascending);
+    # only the first k destinations are materialized — the rest drop
+    dest = jnp.where(keep, jnp.cumsum(keep.astype(jnp.int32)) - 1, n)
+    out_hhi = jnp.full((k,), _U32_MAX).at[dest].set(hhi, mode="drop")
+    out_hlo = jnp.full((k,), _U32_MAX).at[dest].set(hlo, mode="drop")
+    out_vhi = jnp.zeros((k,), jnp.uint32).at[dest].set(vhi, mode="drop")
+    out_vlo = jnp.zeros((k,), jnp.uint32).at[dest].set(vlo, mode="drop")
+    n_unique = jnp.sum(keep).astype(jnp.int32)
+    return out_hhi, out_hlo, out_vhi, out_vlo, jnp.minimum(n_unique, k)
 
 
 def _update_one(

@@ -123,6 +123,69 @@ class BottomKOracle:
             for element in elements:
                 self.sample(element)
 
+    def _as_bits64(self, arr: np.ndarray) -> np.ndarray:
+        """The stream as int64 bit patterns — sign-extended for signed
+        dtypes, zero-extended for unsigned (the ``int(v) & 2^64-1``
+        embedding of :func:`_default_hash`)."""
+        if arr.dtype == np.uint64:
+            return arr.view(np.int64)
+        return arr.astype(np.int64, copy=False)
+
+    def _native_scan(self, arr: np.ndarray) -> bool:
+        """Full-stream scan in the C helper (scramble + threshold compare
+        per element, binary-search insert on the rare accepts).  Returns
+        False when unavailable — caller falls back to the numpy path.
+        Selection is identical to per-element processing (dedup by
+        (hash, value-bits)); only hash-tie ordering between distinct values
+        (~2^-64 per pair) can differ."""
+        import ctypes
+
+        from ..native import load_library
+
+        lib = load_library()
+        if lib is None or not hasattr(lib, "rsv_bottomk_scan"):
+            return False
+        member_dtype = np.uint64 if arr.dtype.kind == "u" else np.int64
+        members = self._member_array(member_dtype)
+        if members is None:
+            return False  # some member doesn't fit this dtype's bit view
+        # serialize (hash, value) sorted by hash ascending
+        entries = sorted((-nh, v) for (nh, _t, v) in self._heap)
+        entry_hash = np.full(self._k, np.iinfo(np.uint64).max, np.uint64)
+        entry_val = np.zeros(self._k, np.int64)
+        size = len(entries)
+        for i, (h, v) in enumerate(entries):
+            entry_hash[i] = h
+            entry_val[i] = np.asarray(v, member_dtype).view(np.int64)
+        bits = np.ascontiguousarray(self._as_bits64(arr))
+        size_c = ctypes.c_int32(size)
+        rc = lib.rsv_bottomk_scan(
+            bits.ctypes.data_as(ctypes.c_void_p),
+            bits.shape[0],
+            ctypes.c_uint64(self._salts[0]),
+            ctypes.c_uint64(self._salts[1]),
+            entry_hash.ctypes.data_as(ctypes.c_void_p),
+            entry_val.ctypes.data_as(ctypes.c_void_p),
+            ctypes.byref(size_c),
+            self._k,
+        )
+        if rc < 0:
+            return False
+        self._count += int(bits.shape[0])
+        new_size = int(size_c.value)
+        vals = entry_val[:new_size].view(member_dtype)
+        self._heap = []
+        self._members = set()
+        for i in range(new_size):
+            v = int(vals[i])
+            self._tie += 1
+            self._heap.append((-int(entry_hash[i]), self._tie, v))
+            self._members.add(v)
+        heapq.heapify(self._heap)
+        # sorted ascending: the last entry is the max retained hash
+        self._max_hash = int(entry_hash[new_size - 1]) if new_size else -1
+        return True
+
     def _sample_all_fast(self, arr: np.ndarray) -> None:
         """Chunked vectorized scan.  Exactness rests on two properties of
         bottom-k: the threshold only ever *tightens*, so a vectorized
@@ -134,7 +197,12 @@ class BottomKOracle:
         hash, so ``np.unique`` on values dedups hash-consistently), drop
         existing members, then insert hash-ascending with an early break at
         the live threshold.  Chunks grow geometrically: as the threshold
-        tightens, ever-larger spans are disposed of by one array compare."""
+        tightens, ever-larger spans are disposed of by one array compare.
+
+        The native C scan (when available) subsumes this whole routine at
+        pointer-walk speed; it is tried first."""
+        if self._native_scan(arr):
+            return
         hashes = scramble64_array(arr, self._salts)
         n = arr.shape[0]
         off = 0

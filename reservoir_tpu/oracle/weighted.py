@@ -91,6 +91,24 @@ class AExpJOracle:
             return math.inf
         return math.log(r) / lt
 
+    def _accept(self, element: Any, weight: float) -> None:
+        """Accept the jump-crossing item: key conditioned into (T^w, 1),
+        then redraw the jump."""
+        lt = self._heap[0][0]
+        t_w = math.exp(weight * lt)
+        r2 = t_w + (1.0 - self._rng.random()) * (1.0 - t_w)
+        lkey = math.log(r2) / weight
+        self._tie += 1
+        heapq.heapreplace(self._heap, (lkey, self._tie, element))
+        self._xw = self._draw_xw()
+
+    def _fill(self, element: Any, weight: float) -> None:
+        u = 1.0 - self._rng.random()
+        self._tie += 1
+        heapq.heappush(self._heap, (math.log(u) / weight, self._tie, element))
+        if len(self._heap) == self._k:
+            self._xw = self._draw_xw()
+
     def sample(self, element: Any, weight: float) -> None:
         if weight < 0:
             raise ValueError(f"weights must be >= 0, got {weight}")
@@ -98,26 +116,58 @@ class AExpJOracle:
         if weight == 0:
             return
         if len(self._heap) < self._k:
-            u = 1.0 - self._rng.random()
-            self._tie += 1
-            heapq.heappush(self._heap, (math.log(u) / weight, self._tie, element))
-            if len(self._heap) == self._k:
-                self._xw = self._draw_xw()
+            self._fill(element, weight)
             return
         self._xw -= weight
         if self._xw <= 0:
-            # this item crosses the jump: accept with key in (T^w, 1)
-            lt = self._heap[0][0]
-            t_w = math.exp(weight * lt)
-            r2 = t_w + (1.0 - self._rng.random()) * (1.0 - t_w)
-            lkey = math.log(r2) / weight
-            self._tie += 1
-            heapq.heapreplace(self._heap, (lkey, self._tie, element))
-            self._xw = self._draw_xw()
+            self._accept(element, weight)
 
     def sample_all(self, pairs: Iterable[Tuple[Any, float]]) -> None:
         for element, weight in pairs:
             self.sample(element, weight)
+
+    def sample_all_arrays(self, elements: np.ndarray, weights: np.ndarray) -> None:
+        """Bulk path over parallel arrays — identical results to per-element
+        calls by construction: ``np.subtract.accumulate`` (float64) replays
+        the exact sequential ``xw -= w`` chain, so jump crossings land on
+        the same items and RNG draws happen in the same order; the segments
+        between accepts are traversed once at C speed (the weighted analog
+        of the skip-jump bulk path, ``Sampler.scala:261-287``)."""
+        elements = np.asarray(elements)
+        weights = np.asarray(weights, np.float64)
+        if weights.shape != elements.shape or elements.ndim != 1:
+            raise ValueError("elements and weights must be matching 1-D arrays")
+        if weights.size and float(weights.min()) < 0:
+            raise ValueError(
+                f"weights must be >= 0, got {float(weights.min())}"
+            )
+        n = elements.shape[0]
+        off = 0
+        # fill phase: per-element until the heap holds k positive items
+        while len(self._heap) < self._k and off < n:
+            self._count += 1
+            w = float(weights[off])
+            if w > 0:
+                self._fill(elements[off], w)
+            off += 1
+        chunk = 8192  # bounds per-accept re-accumulation to O(chunk)
+        while off < n:
+            end = min(off + chunk, n)
+            # replay xw - w[off] - w[off+1] - ... exactly (sequential
+            # float64 accumulate); crossing = first partial <= 0
+            acc = np.subtract.accumulate(
+                np.concatenate(([self._xw], weights[off:end]))
+            )[1:]
+            crossed = np.nonzero(acc <= 0.0)[0]
+            if crossed.size == 0:
+                self._count += end - off
+                self._xw = float(acc[-1])
+                off = end
+                continue
+            j = off + int(crossed[0])
+            self._count += j - off + 1
+            self._accept(elements[j], float(weights[j]))
+            off = j + 1
 
     @property
     def count(self) -> int:

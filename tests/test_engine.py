@@ -145,7 +145,8 @@ class TestPallasDispatch:
             ReservoirEngine(
                 SamplerConfig(max_sample_size=8, num_reservoirs=60, impl="pallas")
             )
-        with pytest.raises(ValueError, match="duplicates"):
+        with pytest.raises(ValueError, match="distinct"):
+            # distinct has no Pallas kernel; weighted does (M4b)
             ReservoirEngine(
                 SamplerConfig(
                     max_sample_size=8, num_reservoirs=64,
@@ -153,6 +154,13 @@ class TestPallasDispatch:
                 ),
                 hash_fn=lambda t: (t.astype("uint32"), t.astype("uint32")),
             )
+        # weighted + pallas is now a supported combination
+        ReservoirEngine(
+            SamplerConfig(
+                max_sample_size=8, num_reservoirs=64,
+                weighted=True, impl="pallas",
+            )
+        )
         with pytest.raises(ValueError, match="map_fn"):
             ReservoirEngine(
                 SamplerConfig(max_sample_size=8, num_reservoirs=64, impl="pallas"),

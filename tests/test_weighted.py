@@ -324,3 +324,45 @@ class TestZeroWeightContract:
             o.sample(i, 1.0 if i % 2 else 0.0)
         assert all(v % 2 == 1 for v in o.result())
         assert o.count == 100
+
+
+def test_aexpj_bulk_arrays_matches_per_element():
+    # the vectorized exponential-jump bulk path must be indistinguishable
+    # from per-element calls: np.subtract.accumulate replays the exact
+    # sequential xw -= w chain, so crossings and RNG draw order are equal
+    rng = np.random.default_rng(1)
+    n = 30_000
+    elems = np.arange(n, dtype=np.int64)
+    wts = (rng.random(n) + 0.5).astype(np.float64)
+    wts[::7] = 0.0  # zero-weight: counted, never sampled
+
+    a = AExpJOracle(64, np.random.default_rng(42))
+    for e, w in zip(elems.tolist(), wts.tolist()):
+        a.sample(e, w)
+    b = AExpJOracle(64, np.random.default_rng(42))
+    b.sample_all_arrays(elems, wts)
+    assert a.count == b.count
+    assert [int(x) for x in a.result()] == [int(x) for x in b.result()]
+
+
+def test_aexpj_bulk_arrays_validation():
+    o = AExpJOracle(8, np.random.default_rng(0))
+    with pytest.raises(ValueError, match=">= 0"):
+        o.sample_all_arrays(
+            np.arange(4, dtype=np.int64), np.array([1.0, -1.0, 1.0, 1.0])
+        )
+    with pytest.raises(ValueError, match="matching"):
+        o.sample_all_arrays(np.arange(4, dtype=np.int64), np.ones(3))
+
+
+def test_weighted_api_array_form():
+    from reservoir_tpu.api import weighted as weighted_factory
+
+    rng = np.random.default_rng(5)
+    elems = np.arange(10_000, dtype=np.int64)
+    wts = rng.random(10_000) + 0.1
+    s1 = weighted_factory(32, rng=9)
+    s1.sample_all(elems, wts)
+    s2 = weighted_factory(32, rng=9)
+    s2.sample_all(zip(elems.tolist(), wts.tolist()))
+    assert [int(x) for x in s1.result()] == [int(x) for x in s2.result()]
