@@ -27,12 +27,12 @@ Env knobs:
                                 (bridge = host-feed: interleaved demux ->
                                 staging -> device flushes, SURVEY §7.3's
                                 "actual likely bottleneck")
-  RESERVOIR_BENCH_IMPL          auto (default) | xla | pallas   (algl and
-                                weighted; auto tries the Pallas kernel on
-                                TPU and falls back to the XLA path if
-                                Mosaic compile/run fails, so the recorded
-                                number is the best impl but a lowering
-                                regression can't erase a round)
+  RESERVOIR_BENCH_IMPL          auto (default) | xla | pallas   (all three
+                                modes; auto tries the Pallas kernel on TPU
+                                and falls back to the XLA path if Mosaic
+                                compile/run fails, so the recorded number
+                                is the best impl but a lowering regression
+                                can't erase a round)
   RESERVOIR_BENCH_PLATFORM=cpu  force the CPU backend (config.update — the
                                 JAX_PLATFORMS env var belongs to the axon
                                 sitecustomize and must not be overridden)
@@ -212,8 +212,18 @@ def _bench_bridge(S, k, B, steps, reps):
     return times
 
 
-def _bench_distinct(R, k, B, steps, reps):
+def _bench_distinct(R, k, B, steps, reps, impl="xla"):
     from reservoir_tpu.ops import distinct as dd
+
+    if impl == "pallas":
+        from reservoir_tpu.ops import distinct_pallas as dp
+
+        step_fn = functools.partial(
+            dp.update_pallas,
+            interpret=jax.default_backend() == "cpu",
+        )
+    else:
+        step_fn = dd.update
 
     @functools.partial(jax.jit, donate_argnums=0)
     def run(state, step0):
@@ -224,7 +234,7 @@ def _bench_distinct(R, k, B, steps, reps):
             # heavy duplication stresses the dedup path (BASELINE config 3)
             u = jr.uniform(sub, (R, B), minval=1e-6)
             batch = jnp.minimum(u ** (-1.0 / 0.1), 1e7).astype(jnp.int32)
-            return (dd.update(state, batch), key), None
+            return (step_fn(state, batch), key), None
 
         (state, _), _ = jax.lax.scan(
             body, (state, jr.fold_in(jr.key(99), step0)),
@@ -325,8 +335,7 @@ def main() -> None:
         if config == "algl":
             times, tag = _run_with_impl(_bench_algl, "algl")
         elif config == "distinct":
-            times = _bench_distinct(R, k, B, steps, reps)
-            tag = "distinct"
+            times, tag = _run_with_impl(_bench_distinct, "distinct")
         elif config == "weighted":
             times, tag = _run_with_impl(_bench_weighted, "weighted")
         else:

@@ -303,8 +303,9 @@ class WeightedSampler:
             else:
                 elems_arr = np.asarray(pairs)
                 weights_arr = np.asarray(weights)
-                if elems_arr.shape != weights_arr.shape:
-                    # zip() would silently truncate the longer side
+                if elems_arr.shape != weights_arr.shape or elems_arr.ndim != 1:
+                    # zip() would silently truncate the longer side, and
+                    # 2-D rows would fail deep in the oracle instead
                     raise ValueError(
                         "elements and weights must be matching 1-D arrays"
                     )

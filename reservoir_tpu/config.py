@@ -105,8 +105,10 @@ class SamplerConfig:
         TPU kernels on TPU backends and the XLA path everywhere else;
         ``"xla"`` never uses Pallas; ``"pallas"`` forces the Pallas kernel
         for eligible updates (Mosaic interpreter on CPU) and fails
-        construction if the config can never be eligible.  Distinct mode
-        has no Pallas kernel (sort-based merge) and always takes XLA.
+        construction if the config can never be eligible.  All three modes
+        have kernels (Algorithm L steady-state, A-ExpJ fill-capable,
+        distinct threshold-scan); user ``map_fn``/``hash_fn`` hooks always
+        take the XLA path.
     """
 
     max_sample_size: int

@@ -99,14 +99,14 @@ class ReservoirEngine:
             # reach a kernel (the "fail fast" validation philosophy of
             # ``Sampler.scala:79-95``).  Duplicates mode: the Algorithm-L
             # kernel is steady-state-only (fill/ragged tiles use XLA);
-            # weighted mode: the A-ExpJ kernel is fill-capable.
-            if self._ops is _distinct:
-                raise ValueError(
-                    "impl='pallas' has no distinct-mode kernel (sort-based "
-                    "merge is the XLA path); use impl='auto'"
-                )
+            # weighted and distinct kernels take every full tile.
             if map_fn is not None:
                 raise ValueError("impl='pallas' requires an identity map_fn")
+            if hash_fn is not None:
+                raise ValueError(
+                    "impl='pallas' requires the default hash (the kernel "
+                    "owns the value-bits embedding); use impl='auto'"
+                )
             block_r = self._pallas_module()._DEFAULT_BLOCK_R
             if config.num_reservoirs % block_r != 0:
                 raise ValueError(
@@ -218,7 +218,7 @@ class ReservoirEngine:
     # -------------------------------------------------------------- sampling
 
     def _pallas_module(self):
-        """The Pallas kernel module for this mode, or None (distinct)."""
+        """The Pallas kernel module for this mode."""
         if self._ops is _algl:
             from .ops import algorithm_l_pallas as _alp
 
@@ -227,7 +227,9 @@ class ReservoirEngine:
             from .ops import weighted_pallas as _wp
 
             return _wp
-        return None
+        from .ops import distinct_pallas as _dp
+
+        return _dp
 
     def _pallas_eligible(self, steady: bool, ragged: bool, tile_dtype) -> bool:
         """Dispatch gate for the Pallas kernels (VERDICT r1 item 2): the
@@ -237,14 +239,24 @@ class ReservoirEngine:
         weighted M4b kernel is fill-capable."""
         if self._config.impl == "xla":
             return False
-        if ragged or self._map_fn is not None:
+        if ragged or self._map_fn is not None or self._hash_fn is not None:
+            return False
+        if self._ops is _algl and not steady:
             return False
         mod = self._pallas_module()
-        if mod is None or (self._ops is _algl and not steady):
+        if not mod.supports(self._state, None, None):
             return False
-        if not mod.supports(self._state, None, None) or (
-            jnp.dtype(tile_dtype) != self._state.samples.dtype
-        ):
+        if self._config.distinct:
+            # the kernel owns the default-hash embedding: 4-byte *integer*
+            # tiles (the XLA path value-converts other dtypes, the kernel
+            # bit-views — only integers agree) and (hi, lo) planes for wide
+            # keys (validated by engine.sample)
+            if not self._wide and (
+                jnp.dtype(tile_dtype).itemsize != 4
+                or jnp.dtype(tile_dtype).kind not in "iu"
+            ):
+                return False
+        elif jnp.dtype(tile_dtype) != self._state.samples.dtype:
             return False
         if self._mesh is not None:
             # under shard_map each chip runs the kernel on its own

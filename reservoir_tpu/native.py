@@ -41,6 +41,22 @@ def load_error() -> Optional[str]:
     return _load_error
 
 
+def _so_stale() -> bool:
+    """True when the .so is missing or older than any .cc/Makefile source."""
+    try:
+        so_mtime = os.path.getmtime(_SO_PATH)
+    except OSError:
+        return True
+    for name in os.listdir(_NATIVE_DIR):
+        if name.endswith(".cc") or name == "Makefile":
+            try:
+                if os.path.getmtime(os.path.join(_NATIVE_DIR, name)) > so_mtime:
+                    return True
+            except OSError:
+                return True
+    return False
+
+
 def load_library(rebuild: bool = False) -> Optional[ctypes.CDLL]:
     """Load (building on first use if needed) the native library; None if
     unavailable — callers fall back to numpy, and :func:`load_error` says why."""
@@ -53,24 +69,31 @@ def load_library(rebuild: bool = False) -> Optional[ctypes.CDLL]:
         if _load_attempted and not rebuild:
             return _lib
         _load_attempted = True
-        # always run make: a no-op when the .so is newer than the sources,
-        # a rebuild when a source file (e.g. a newly added helper) changed
-        try:
-            subprocess.run(
-                ["make", "-C", _NATIVE_DIR],
-                check=True,
-                capture_output=True,
-                timeout=120,
-            )
-        except (OSError, subprocess.SubprocessError) as e:
-            _load_error = f"native build failed: {e}"
-            if not os.path.exists(_SO_PATH):
-                return None  # no stale .so to fall back on either
+        # invoke make only when the .so is missing or older than a source
+        # file — a stat comparison in-process, so the common warm path never
+        # forks a subprocess (and concurrent fresh processes rarely race;
+        # the Makefile builds to a temp name and mv's for atomicity)
+        if rebuild or _so_stale():
+            try:
+                subprocess.run(
+                    ["make", "-C", _NATIVE_DIR],
+                    check=True,
+                    capture_output=True,
+                    timeout=120,
+                )
+            except (OSError, subprocess.SubprocessError) as e:
+                _load_error = f"native build failed: {e}"
+                if not os.path.exists(_SO_PATH):
+                    return None  # no stale .so to fall back on either
         try:
             lib = ctypes.CDLL(_SO_PATH)
         except OSError as e:
             _load_error = f"dlopen failed: {e}"
             return None
+        if _load_error and _load_error.startswith("native build failed"):
+            # a stale-but-working .so loaded: the native path IS live; keep
+            # the contract that load_error() == None means "native in use"
+            _load_error = None
         return _finish_load(lib)
 
 

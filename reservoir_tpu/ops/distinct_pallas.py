@@ -1,0 +1,324 @@
+"""Pallas TPU kernel for distinct-mode (bottom-k) tile merges (M4c).
+
+The XLA path (:mod:`.distinct`) pays an O((k+B) log(k+B)) multi-key
+``lax.sort`` per tile regardless of how many elements could possibly enter
+the reservoir.  But once the reservoir is warm, almost every element fails
+the threshold compare — the same observation behind the reference's one-
+compare hot loop (``Sampler.scala:403-408``) and the native host scan
+(``_native/bottom_k.cc``).  This kernel keeps the sorted bottom-k resident
+in VMEM and does per tile:
+
+- scramble (same integer-exact :func:`~reservoir_tpu.ops.hashing.scramble64`
+  — VPU-elementwise, no 64-bit lanes: (hi, lo) uint32 limb pairs);
+- one lexicographic threshold compare per element (the hot path);
+- an acceptance loop over the *distinct below-threshold values* only: each
+  iteration selects the minimum candidate hash, dedups against the resident
+  entries, inserts in sorted position by a k-wide shift, and masks every
+  tile lane carrying the same (hash, value) — so within-tile duplicates
+  cost one iteration total, not one each.
+
+State equality with the XLA sort-merge path is exact: both maintain the
+same canonical representation (entries sorted by (hash, value-bits)
+ascending, (MAX, MAX)/0 padding, explicit size), and insertion position
+counts (hash, value) lexicographically, so even 64-bit hash ties land
+identically.  Sole caveat (shared with the native host scan): a value
+whose scrambled hash is exactly (MAX, MAX) is never accepted by the
+strict threshold compare, where the XLA path's pad-flag would keep it —
+probability 2^-64, the documented bias class.  Pinned by
+``tests/test_pallas_distinct.py`` in interpret mode and by the engine
+dispatch equivalence tests.
+
+Scope (engine dispatch via :func:`supports`): full tiles, identity
+``map_fn``/default hash, int32 counters, narrow (4-byte) or wide (8-byte
+bit-plane) keys, R divisible by the row-block size.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+import numpy as np
+
+from .distinct import DistinctState
+from .hashing import scramble64
+
+__all__ = ["supports", "update_pallas"]
+
+_DEFAULT_BLOCK_R = 8
+
+
+def supports(
+    state: DistinctState,
+    valid,
+    map_fn,
+    block_r: int = _DEFAULT_BLOCK_R,
+    batch=None,
+) -> bool:
+    """True iff this kernel can take the tile (else: XLA path)."""
+    return (
+        valid is None
+        and map_fn is None
+        and state.count.dtype == jnp.int32
+        and state.values.shape[0] % block_r == 0
+    )
+
+
+def _sign_extend_hi(lo_bits):
+    """uint32 hi plane of a sign-extended 4-byte value (the
+    ``default_hash64`` embedding, shared with the XLA path)."""
+    return (lo_bits.astype(jnp.int32) >> jnp.int32(31)).view(jnp.uint32)
+
+
+def _lex_lt(ahi, alo, bhi, blo):
+    """(ahi, alo) < (bhi, blo) as 64-bit lexicographic uint compare."""
+    return (ahi < bhi) | ((ahi == bhi) & (alo < blo))
+
+
+def _kernel(
+    values_ref,
+    vhi_ref,  # value hi plane ([r, k]; in narrow mode a recomputed view)
+    hhi_ref,
+    hlo_ref,
+    size_ref,
+    salts_ref,
+    bvlo_ref,
+    bvhi_ref,
+    out_values_ref,
+    out_vhi_ref,
+    out_hhi_ref,
+    out_hlo_ref,
+    out_size_ref,
+    *,
+    k: int,
+    block_b: int,
+):
+    """One grid cell = one ``[block_r]`` row-block of reservoirs × one tile."""
+    block_r = size_ref.shape[0]
+    del block_b  # tile width is implicit in the refs' second axis
+    lane_k = jax.lax.broadcasted_iota(jnp.int32, (block_r, k), 1)
+
+    # scramble the tile's (hi, lo) value planes under the per-lane salts
+    bvhi = bvhi_ref[:, :]
+    bvlo = bvlo_ref[:, :]
+    bhhi, bhlo = scramble64(
+        bvhi,
+        bvlo,
+        salts_ref[:, 0:1],
+        salts_ref[:, 1:2],
+        salts_ref[:, 2:3],
+        salts_ref[:, 3:4],
+    )
+
+    out_values_ref[:, :] = values_ref[:, :]
+    out_vhi_ref[:, :] = vhi_ref[:, :]
+    out_hhi_ref[:, :] = hhi_ref[:, :]
+    out_hlo_ref[:, :] = hlo_ref[:, :]
+
+    # candidates: below the running threshold = the max retained hash when
+    # full, (MAX, MAX) otherwise — i.e. simply the last entry of the sorted
+    # block (padding IS (MAX, MAX))
+    def threshold():
+        last = lane_k == (k - 1)
+        thi = jnp.sum(jnp.where(last, out_hhi_ref[:, :], 0), axis=1, keepdims=True)
+        tlo = jnp.sum(jnp.where(last, out_hlo_ref[:, :], 0), axis=1, keepdims=True)
+        return thi.astype(jnp.uint32), tlo.astype(jnp.uint32)
+
+    thi, tlo = threshold()
+    cand = _lex_lt(bhhi, bhlo, thi, tlo)  # [r, B]
+
+    def cond(carry):
+        cand_c, _ = carry
+        return jnp.any(cand_c)
+
+    def body(carry):
+        cand_c, size_c = carry
+        active = jnp.any(cand_c, axis=1, keepdims=True)  # [r, 1]
+        # minimum candidate hash, lexicographic over (hi, lo)
+        mhi = jnp.min(
+            jnp.where(cand_c, bhhi, np.uint32(0xFFFFFFFF)), axis=1, keepdims=True
+        )
+        is_mhi = cand_c & (bhhi == mhi)
+        mlo = jnp.min(
+            jnp.where(is_mhi, bhlo, np.uint32(0xFFFFFFFF)), axis=1, keepdims=True
+        )
+        hit = is_mhi & (bhlo == mlo)
+        # first tile lane carrying (mhi, mlo): its value bits
+        first = hit & (jnp.cumsum(hit.astype(jnp.int32), axis=1) == 1)
+        vlo = jnp.sum(
+            jnp.where(first, bvlo_ref[:, :], jnp.uint32(0)),
+            axis=1,
+            keepdims=True,
+        ).astype(jnp.uint32)
+        vhi = jnp.sum(
+            jnp.where(first, bvhi_ref[:, :], jnp.uint32(0)),
+            axis=1,
+            keepdims=True,
+        ).astype(jnp.uint32)
+        # dedup: (hash, value) already resident?
+        ehhi = out_hhi_ref[:, :]
+        ehlo = out_hlo_ref[:, :]
+        evlo = (
+            jax.lax.bitcast_convert_type(out_values_ref[:, :], jnp.uint32)
+            if out_values_ref.dtype != jnp.uint32
+            else out_values_ref[:, :]
+        )
+        evhi = out_vhi_ref[:, :]
+        same = (ehhi == mhi) & (ehlo == mlo) & (evlo == vlo) & (evhi == vhi)
+        present = jnp.any(same, axis=1, keepdims=True)
+        do_insert = active & ~present
+        # insertion position: lexicographic rank of (hash, value) among
+        # resident entries — identical to the XLA sort-merge layout,
+        # including 64-bit hash ties
+        ins_lt = _lex_lt(ehhi, ehlo, mhi, mlo) | (
+            (ehhi == mhi)
+            & (ehlo == mlo)
+            & ((evhi < vhi) | ((evhi == vhi) & (evlo < vlo)))
+        )
+        pos = jnp.sum(ins_lt.astype(jnp.int32), axis=1, keepdims=True)
+        # k-wide sorted insert: entries < pos stay, == pos take the new
+        # entry, > pos shift right by one (last entry drops; lane 0 never
+        # shifts, so roll's wraparound value is always masked)
+        take_new = (lane_k == pos) & do_insert
+        shift = (lane_k > pos) & do_insert
+        for ref, new_col in (
+            (out_hhi_ref, mhi),
+            (out_hlo_ref, mlo),
+            (out_vhi_ref, vhi),
+        ):
+            cur = ref[:, :]
+            rolled = jnp.roll(cur, 1, axis=1)
+            ref[:, :] = jnp.where(
+                take_new, new_col.astype(cur.dtype),
+                jnp.where(shift, rolled, cur),
+            )
+        cur = out_values_ref[:, :]
+        rolled = jnp.roll(cur, 1, axis=1)
+        if out_values_ref.dtype == jnp.uint32:
+            new_v = vlo
+        else:
+            new_v = jax.lax.bitcast_convert_type(vlo, out_values_ref.dtype)
+        out_values_ref[:, :] = jnp.where(
+            take_new, new_v, jnp.where(shift, rolled, cur)
+        )
+        size_n = jnp.where(
+            do_insert, jnp.minimum(size_c + 1, k), size_c
+        )
+        # retire every tile lane carrying this (hash, value) — within-tile
+        # duplicates cost one iteration total
+        consumed = (
+            (bhhi == mhi) & (bhlo == mlo)
+            & (bvhi_ref[:, :] == vhi) & (bvlo_ref[:, :] == vlo)
+        )
+        cand_n = cand_c & ~consumed
+        # the threshold may have tightened; re-mask candidates
+        last = lane_k == (k - 1)
+        thi_n = jnp.sum(
+            jnp.where(last, out_hhi_ref[:, :], 0), axis=1, keepdims=True
+        ).astype(jnp.uint32)
+        tlo_n = jnp.sum(
+            jnp.where(last, out_hlo_ref[:, :], 0), axis=1, keepdims=True
+        ).astype(jnp.uint32)
+        cand_n = cand_n & _lex_lt(bhhi, bhlo, thi_n, tlo_n)
+        return cand_n, size_n
+
+    _, size = jax.lax.while_loop(cond, body, (cand, size_ref[:, :]))
+    out_size_ref[:, :] = size
+
+
+def update_pallas(
+    state: DistinctState,
+    batch,
+    *,
+    block_r: int = _DEFAULT_BLOCK_R,
+    interpret: bool = False,
+) -> DistinctState:
+    """Full-tile distinct merge, state-identical to
+    :func:`reservoir_tpu.ops.distinct.update` on full tiles (default hash).
+
+    ``batch`` is ``[R, B]`` (narrow) or an ``(hi, lo)`` uint32 plane pair
+    (wide).  Requires :func:`supports`.
+    """
+    R, k = state.values.shape
+    wide = state.wide
+    if wide and not isinstance(batch, tuple):
+        raise ValueError("wide states take (hi, lo) uint32 plane pairs")
+    if not supports(state, None, None, block_r, batch):
+        raise ValueError(
+            "update_pallas: unsupported config (need int32 counters, "
+            f"R % {block_r} == 0, full tiles); use ops.distinct.update"
+        )
+    if wide:
+        bvhi, bvlo = batch
+        bvhi = bvhi.astype(jnp.uint32)
+        bvlo = bvlo.astype(jnp.uint32)
+        cvhi = state.value_hi
+        cvalues = state.values
+    else:
+        b = batch
+        bvlo = b.view(jnp.uint32) if b.dtype != jnp.uint32 else b
+        bvhi = _sign_extend_hi(bvlo)
+        from .distinct import _carried_hi
+
+        cvhi = _carried_hi(state.values)
+        cvalues = state.values
+    B = bvlo.shape[1]
+    if bvlo.shape[0] != R:
+        raise ValueError(f"batch has {bvlo.shape[0]} rows for {R} reservoirs")
+
+    col = lambda i: (i, 0)  # noqa: E731 — row-block i, full second axis
+    col_spec = lambda w: pl.BlockSpec(  # noqa: E731
+        (block_r, w), col, memory_space=pltpu.VMEM
+    )
+
+    out_values, out_vhi, out_hhi, out_hlo, out_size = pl.pallas_call(
+        functools.partial(_kernel, k=k, block_b=B),
+        grid=(R // block_r,),
+        in_specs=[
+            col_spec(k),
+            col_spec(k),
+            col_spec(k),
+            col_spec(k),
+            col_spec(1),
+            col_spec(4),
+            col_spec(B),
+            col_spec(B),
+        ],
+        out_specs=(
+            col_spec(k),
+            col_spec(k),
+            col_spec(k),
+            col_spec(k),
+            col_spec(1),
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((R, k), state.values.dtype),
+            jax.ShapeDtypeStruct((R, k), jnp.uint32),
+            jax.ShapeDtypeStruct((R, k), jnp.uint32),
+            jax.ShapeDtypeStruct((R, k), jnp.uint32),
+            jax.ShapeDtypeStruct((R, 1), jnp.int32),
+        ),
+        interpret=interpret,
+    )(
+        cvalues,
+        cvhi,
+        state.hash_hi,
+        state.hash_lo,
+        state.size.reshape(R, 1),
+        state.salts,
+        bvlo,
+        bvhi,
+    )
+    return DistinctState(
+        values=out_values,
+        hash_hi=out_hhi,
+        hash_lo=out_hlo,
+        size=out_size.reshape(R),
+        count=state.count + jnp.asarray(B, state.count.dtype),
+        salts=state.salts,
+        value_hi=out_vhi if wide else None,
+    )

@@ -137,9 +137,10 @@ class AExpJOracle:
         weights = np.asarray(weights, np.float64)
         if weights.shape != elements.shape or elements.ndim != 1:
             raise ValueError("elements and weights must be matching 1-D arrays")
-        if weights.size and float(weights.min()) < 0:
+        if not np.all(weights >= 0):  # also rejects NaN (min() would not)
             raise ValueError(
-                f"weights must be >= 0, got {float(weights.min())}"
+                "weights must be >= 0 (and not NaN); got "
+                f"min {float(weights.min()) if weights.size else 0}"
             )
         n = elements.shape[0]
         off = 0

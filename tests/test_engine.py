@@ -145,8 +145,9 @@ class TestPallasDispatch:
             ReservoirEngine(
                 SamplerConfig(max_sample_size=8, num_reservoirs=60, impl="pallas")
             )
-        with pytest.raises(ValueError, match="distinct"):
-            # distinct has no Pallas kernel; weighted does (M4b)
+        with pytest.raises(ValueError, match="default hash"):
+            # the distinct kernel owns the default-hash embedding; a user
+            # hash hook must take the XLA path (impl='auto')
             ReservoirEngine(
                 SamplerConfig(
                     max_sample_size=8, num_reservoirs=64,
@@ -154,11 +155,17 @@ class TestPallasDispatch:
                 ),
                 hash_fn=lambda t: (t.astype("uint32"), t.astype("uint32")),
             )
-        # weighted + pallas is now a supported combination
+        # weighted + pallas (M4b) and distinct + pallas (M4c) are supported
         ReservoirEngine(
             SamplerConfig(
                 max_sample_size=8, num_reservoirs=64,
                 weighted=True, impl="pallas",
+            )
+        )
+        ReservoirEngine(
+            SamplerConfig(
+                max_sample_size=8, num_reservoirs=64,
+                distinct=True, impl="pallas",
             )
         )
         with pytest.raises(ValueError, match="map_fn"):

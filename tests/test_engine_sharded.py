@@ -219,3 +219,67 @@ def test_engine_weighted_pallas_bit_identical():
     np.testing.assert_array_equal(z0, z1)
     np.testing.assert_array_equal(s0, s2)
     np.testing.assert_array_equal(z0, z2)
+
+
+def test_engine_distinct_pallas_bit_identical():
+    # M4c: the distinct kernel through the engine — XLA sort-merge,
+    # single-device Pallas, and Pallas-under-shard_map must produce the
+    # same state (canonical sorted representation on all paths)
+    Rp, Kp, Bp = 64, 16, 64
+    rng = np.random.default_rng(11)
+    tiles = [rng.integers(0, 200, (Rp, Bp)).astype(np.int32) for _ in range(3)]
+    results = []
+    for kw in (
+        dict(impl="xla"),
+        dict(impl="pallas"),
+        dict(impl="pallas", mesh_axis="res"),
+    ):
+        eng = ReservoirEngine(
+            SamplerConfig(
+                max_sample_size=Kp,
+                num_reservoirs=Rp,
+                tile_size=Bp,
+                distinct=True,
+                **kw,
+            ),
+            key=13,
+            reusable=True,
+        )
+        for t in tiles:
+            eng.sample(t)
+        results.append(eng.result_arrays())
+    (s0, z0), (s1, z1), (s2, z2) = results
+    np.testing.assert_array_equal(s0, s1)
+    np.testing.assert_array_equal(z0, z1)
+    np.testing.assert_array_equal(s0, s2)
+    np.testing.assert_array_equal(z0, z2)
+
+
+def test_engine_distinct_pallas_wide_bit_identical():
+    # 64-bit keys ride as (hi, lo) planes through the kernel too
+    Rp, Kp, Bp = 16, 8, 32
+    rng = np.random.default_rng(12)
+    tiles = [
+        rng.integers(-(2**62), 2**62, (Rp, Bp)).astype(np.int64)
+        for _ in range(2)
+    ]
+    results = []
+    for kw in (dict(impl="xla"), dict(impl="pallas")):
+        eng = ReservoirEngine(
+            SamplerConfig(
+                max_sample_size=Kp,
+                num_reservoirs=Rp,
+                tile_size=Bp,
+                distinct=True,
+                sample_dtype="int64",
+                **kw,
+            ),
+            key=14,
+            reusable=True,
+        )
+        for t in tiles:
+            eng.sample(t)
+        results.append(eng.result_arrays())
+    (s0, z0), (s1, z1) = results
+    np.testing.assert_array_equal(s0, s1)
+    np.testing.assert_array_equal(z0, z1)
