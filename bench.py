@@ -23,10 +23,13 @@ Prints exactly ONE JSON line:
 
 Env knobs:
   RESERVOIR_BENCH_SMOKE=1       tiny shapes for a CPU smoke run
-  RESERVOIR_BENCH_CONFIG        algl (default) | distinct | weighted | bridge
-                                (bridge = host-feed: interleaved demux ->
-                                staging -> device flushes, SURVEY §7.3's
-                                "actual likely bottleneck")
+  RESERVOIR_BENCH_CONFIG        algl (default) | distinct | weighted |
+                                bridge | stream
+                                (bridge = incremental host-feed: interleaved
+                                demux -> staging -> per-flush dispatches;
+                                stream = fused host-feed: one scanned
+                                dispatch over a host [R, N] array — the two
+                                ends of SURVEY §7.3's host-path spectrum)
   RESERVOIR_BENCH_IMPL          auto (default) | xla | pallas   (all three
                                 modes; auto tries the Pallas kernel on TPU
                                 and falls back to the XLA path if Mosaic
@@ -212,6 +215,37 @@ def _bench_bridge(S, k, B, steps, reps):
     return times
 
 
+def _bench_stream(R, k, B, steps, reps, impl="auto"):
+    """Fused host-feed: a host-resident [R, N] stream through
+    ``engine.sample_stream(fused=True)`` — one transfer + one scanned
+    dispatch for all tiles, vs the bridge's per-flush round-trips.  This is
+    the wire-speed ceiling of host feeding (SURVEY §7.3).  ``impl`` rides
+    into the engine config (auto picks the kernel per backend)."""
+    from reservoir_tpu import ReservoirEngine, SamplerConfig
+
+    cfg = SamplerConfig(
+        max_sample_size=k, num_reservoirs=R, tile_size=B, impl=impl
+    )
+    eng = ReservoirEngine(cfg, key=0, reusable=True)
+    rng = np.random.default_rng(0)
+    stream = rng.integers(0, 1 << 31, (R, B * steps), dtype=np.int64).astype(
+        np.int32
+    )
+
+    def one_pass():
+        eng.sample_stream(stream, fused=True)
+        _readback_barrier(eng._state.count)
+
+    one_pass()  # warm: compiles the fill-regime scan
+    one_pass()  # warm: compiles the steady-regime scan (the timed regime)
+    times = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        one_pass()
+        times.append(time.perf_counter() - t0)
+    return times
+
+
 def _bench_distinct(R, k, B, steps, reps, impl="xla"):
     from reservoir_tpu.ops import distinct as dd
 
@@ -278,10 +312,10 @@ def main() -> None:
     smoke = os.environ.get("RESERVOIR_BENCH_SMOKE") == "1"
     config = os.environ.get("RESERVOIR_BENCH_CONFIG", "algl")
     impl = os.environ.get("RESERVOIR_BENCH_IMPL", "auto")
-    if config not in ("algl", "distinct", "weighted", "bridge"):
+    if config not in ("algl", "distinct", "weighted", "bridge", "stream"):
         raise SystemExit(
-            "RESERVOIR_BENCH_CONFIG must be algl|distinct|weighted|bridge, "
-            f"got {config!r}"
+            "RESERVOIR_BENCH_CONFIG must be algl|distinct|weighted|bridge|"
+            f"stream, got {config!r}"
         )
     if impl not in ("auto", "xla", "pallas"):
         raise SystemExit(
@@ -292,11 +326,14 @@ def main() -> None:
         "distinct": (256 if smoke else 4096, 32 if smoke else 256, 1024),
         "weighted": (512 if smoke else 16384, 64, 1024),
         "bridge": (64 if smoke else 1024, 128, 128 if smoke else 1024),
+        "stream": (64 if smoke else 1024, 128, 128 if smoke else 2048),
     }[config]
     R = int(os.environ.get("RESERVOIR_BENCH_R", defaults[0]))
     k = int(os.environ.get("RESERVOIR_BENCH_K", defaults[1]))
     B = int(os.environ.get("RESERVOIR_BENCH_B", defaults[2]))
-    default_steps = {"bridge": 2 if smoke else 4}.get(config, 5 if smoke else 50)
+    default_steps = {"bridge": 2 if smoke else 4, "stream": 2 if smoke else 16}.get(
+        config, 5 if smoke else 50
+    )
     steps = int(os.environ.get("RESERVOIR_BENCH_STEPS", default_steps))
     reps = int(os.environ.get("RESERVOIR_BENCH_REPS", 3))
 
@@ -338,6 +375,9 @@ def main() -> None:
             times, tag = _run_with_impl(_bench_distinct, "distinct")
         elif config == "weighted":
             times, tag = _run_with_impl(_bench_weighted, "weighted")
+        elif config == "stream":
+            times = _bench_stream(R, k, B, steps, reps, impl)
+            tag = f"stream_fused_host_feed_{impl}"
         else:
             times = _bench_bridge(R, k, B, steps, reps)
             tag = "bridge_host_feed"

@@ -272,52 +272,60 @@ class ReservoirEngine:
         # path (the CPU interpreter would also be far slower than XLA)
         return jax.default_backend() == "tpu"
 
+    def _base_update(self, steady: bool, use_pallas: bool):
+        """The traceable per-tile update ``(state, tile[, weights][, valid])
+        -> state`` for this mode — Pallas kernel (shard_map-wrapped on a
+        mesh) or XLA path.  Shared by the per-tile jit cache and the fused
+        stream scan."""
+        if use_pallas:
+            mod = self._pallas_module()
+            kernel = (
+                mod.update_steady_pallas
+                if self._ops is _algl
+                else mod.update_pallas
+            )
+            base = functools.partial(
+                kernel, interpret=jax.default_backend() == "cpu"
+            )
+            if self._mesh is not None:
+                # pallas_call is not auto-partitionable — run it under
+                # shard_map so each chip takes its reservoir row-blocks
+                # (the kernel is collective-free over the grid)
+                from jax.sharding import PartitionSpec as _P
+
+                axis = self._config.mesh_axis
+                specs = jax.tree.map(
+                    lambda x: _P(axis, *([None] * (x.ndim - 1))),
+                    self._state,
+                )
+                tile_specs = (_P(axis, None),) * (
+                    2 if self._config.weighted else 1
+                )
+                base = jax.shard_map(
+                    base,
+                    mesh=self._mesh,
+                    in_specs=(specs,) + tile_specs,
+                    out_specs=specs,
+                    # pallas_call out_shapes carry no varying-mesh-axes
+                    # info; the kernel is collective-free over the grid,
+                    # so the vma check adds nothing here
+                    check_vma=False,
+                )
+            return base
+        base = self._ops.update_steady if steady else self._ops.update
+        kwargs = {"map_fn": self._map_fn}
+        if self._config.distinct:
+            kwargs["hash_fn"] = self._hash_fn
+        return functools.partial(base, **kwargs)
+
     def _update_fn(self, width: int, steady: bool, ragged: bool, tile_dtype):
         use_pallas = self._pallas_eligible(steady, ragged, tile_dtype)
         cache_key = (width, steady, ragged, use_pallas)
         fn = self._jit_cache.get(cache_key)
         if fn is None:
-            if use_pallas:
-                mod = self._pallas_module()
-                kernel = (
-                    mod.update_steady_pallas
-                    if self._ops is _algl
-                    else mod.update_pallas
-                )
-                base = functools.partial(
-                    kernel, interpret=jax.default_backend() == "cpu"
-                )
-                if self._mesh is not None:
-                    # pallas_call is not auto-partitionable — run it under
-                    # shard_map so each chip takes its reservoir row-blocks
-                    # (the kernel is collective-free over the grid)
-                    from jax.sharding import PartitionSpec as _P
-
-                    axis = self._config.mesh_axis
-                    specs = jax.tree.map(
-                        lambda x: _P(axis, *([None] * (x.ndim - 1))),
-                        self._state,
-                    )
-                    tile_specs = (_P(axis, None),) * (
-                        2 if self._config.weighted else 1
-                    )
-                    base = jax.shard_map(
-                        base,
-                        mesh=self._mesh,
-                        in_specs=(specs,) + tile_specs,
-                        out_specs=specs,
-                        # pallas_call out_shapes carry no varying-mesh-axes
-                        # info; the kernel is collective-free over the grid,
-                        # so the vma check adds nothing here
-                        check_vma=False,
-                    )
-            else:
-                base = self._ops.update_steady if steady else self._ops.update
-                kwargs = {"map_fn": self._map_fn}
-                if self._config.distinct:
-                    kwargs["hash_fn"] = self._hash_fn
-                base = functools.partial(base, **kwargs)
-            fn = jax.jit(base, donate_argnums=(0,))
+            fn = jax.jit(
+                self._base_update(steady, use_pallas), donate_argnums=(0,)
+            )
             self._jit_cache[cache_key] = fn
         return fn
 
@@ -328,6 +336,8 @@ class ReservoirEngine:
         the batched analog of ``Sampler.scala:248-259``).  Weighted engines
         additionally require a strictly positive ``[R, B]`` weight tile."""
         self._check_open()
+        tile_host: Optional[np.ndarray] = None  # host part staged below
+        weights_host: Optional[np.ndarray] = None
         if self._wide:
             tile_np = np.asarray(tile)
             if tile_np.dtype.kind not in "iu" or tile_np.dtype.itemsize != 8:
@@ -347,19 +357,25 @@ class ReservoirEngine:
             tile_shape, tile_dtype = tile_np.shape, tile_np.dtype
         else:
             if not isinstance(tile, jax.Array):
-                # async device_put, NOT jnp.asarray: on tunneled backends
-                # asarray transfers synchronously in chunks (measured 228ms
-                # vs 2.5ms pipelined for a 4MB tile) — it would serialize
-                # every flush on host->device latency.  The host copy makes
-                # the async transfer safe against callers that reuse their
-                # buffer (the bridge's staging tile does exactly that).
-                tile = jax.device_put(np.array(tile, copy=True))
-            if tile.ndim != 2 or tile.shape[0] != self._config.num_reservoirs:
+                # snapshot now (callers may reuse their buffer under the
+                # async transfer — the bridge's staging tile does exactly
+                # that), but defer the device_put: all host parts of this
+                # call ship in ONE async transfer below.  Never jnp.asarray:
+                # on tunneled backends it transfers synchronously in chunks
+                # (measured 228ms vs 2.5ms pipelined for a 4MB tile).
+                tile_host = np.array(tile, copy=True)
+                tile_probe = tile_host
+            else:
+                tile_probe = tile
+            if (
+                tile_probe.ndim != 2
+                or tile_probe.shape[0] != self._config.num_reservoirs
+            ):
                 raise ValueError(
                     f"tile must be [num_reservoirs="
-                    f"{self._config.num_reservoirs}, B], got {tile.shape}"
+                    f"{self._config.num_reservoirs}, B], got {tile_probe.shape}"
                 )
-            tile_shape, tile_dtype = tile.shape, tile.dtype
+            tile_shape, tile_dtype = tile_probe.shape, tile_probe.dtype
         if self._config.weighted:
             if weights is None:
                 raise ValueError("weighted engine requires a weights tile")
@@ -371,20 +387,22 @@ class ReservoirEngine:
             # oracle's contract, ops.weighted module docs).
             if not isinstance(weights, jax.Array):
                 w_in = weights
-                weights = np.asarray(w_in, np.float32)
-                if not np.all(weights >= 0):
+                weights_host = np.asarray(w_in, np.float32)
+                if not np.all(weights_host >= 0):
                     raise ValueError("weights must be nonnegative")
-                if weights is w_in:
+                if weights_host is w_in:
                     # no conversion copy happened — snapshot before the
                     # async device_put (caller may reuse its buffer)
-                    weights = weights.copy()
-                weights = jax.device_put(weights)
-            elif weights.dtype != jnp.float32:
-                weights = weights.astype(jnp.float32)
-            if tuple(weights.shape) != tuple(tile_shape):
+                    weights_host = weights_host.copy()
+                w_probe = weights_host
+            else:
+                if weights.dtype != jnp.float32:
+                    weights = weights.astype(jnp.float32)
+                w_probe = weights
+            if tuple(w_probe.shape) != tuple(tile_shape):
                 raise ValueError(
                     f"weights must match tile shape {tuple(tile_shape)}, "
-                    f"got {tuple(weights.shape)}"
+                    f"got {tuple(w_probe.shape)}"
                 )
         elif weights is not None:
             raise ValueError("weights are only meaningful with weighted=True")
@@ -401,20 +419,8 @@ class ReservoirEngine:
             and self._min_count >= self._config.max_sample_size
         )
         fn = self._update_fn(width, steady, valid is not None, tile_dtype)
-        if self._mesh is not None:
-            # commit the tile to the mesh so each chip receives only its
-            # reservoir shard and the update compiles collective-free
-            # (wide tiles are (hi, lo) plane pairs — place each plane)
-            tile = jax.tree.map(
-                lambda t: jax.device_put(t, self._tile_sharding), tile
-            )
-            if weights is not None:
-                weights = jax.device_put(weights, self._tile_sharding)
-        args = (tile, weights) if self._config.weighted else (tile,)
-        if valid is None:
-            self._state = fn(self._state, *args)
-            self._min_count += width
-        else:
+        valid_np: Optional[np.ndarray] = None
+        if valid is not None:
             valid_np = np.array(valid, np.int32, copy=True)  # async-put safe
             if valid_np.shape != (self._config.num_reservoirs,):
                 raise ValueError(
@@ -425,11 +431,50 @@ class ReservoirEngine:
                     f"valid entries must be in [0, {width}], got "
                     f"[{valid_np.min()}, {valid_np.max()}]"
                 )
-            valid_dev = jax.device_put(
-                valid_np,
-                self._row_sharding if self._mesh is not None else None,
-            )
-            self._state = fn(self._state, *args, valid_dev)
+        # ONE async device_put for every host-resident part of this call:
+        # per-op RPC latency dominates flushes on tunneled backends
+        # (~30ms each), so tile+weights+valid ride a single transfer.
+        stage = {}
+        if tile_host is not None:
+            stage["tile"] = tile_host
+        if weights_host is not None:
+            stage["weights"] = weights_host
+        if valid_np is not None:
+            stage["valid"] = valid_np
+        if stage:
+            if self._mesh is not None:
+                shards = {
+                    "tile": self._tile_sharding,
+                    "weights": self._tile_sharding,
+                    "valid": self._row_sharding,
+                }
+                placed = jax.device_put(
+                    stage, {key: shards[key] for key in stage}
+                )
+            else:
+                placed = jax.device_put(stage)
+        else:
+            placed = {}
+        if tile_host is not None:
+            tile = placed["tile"]
+        if weights_host is not None:
+            weights = placed["weights"]
+        if self._mesh is not None:
+            # commit device-resident inputs to the mesh too, so each chip
+            # receives only its reservoir shard and the update compiles
+            # collective-free (wide tiles are (hi, lo) plane pairs)
+            if tile_host is None:
+                tile = jax.tree.map(
+                    lambda t: jax.device_put(t, self._tile_sharding), tile
+                )
+            if weights is not None and weights_host is None:
+                weights = jax.device_put(weights, self._tile_sharding)
+        args = (tile, weights) if self._config.weighted else (tile,)
+        if valid is None:
+            self._state = fn(self._state, *args)
+            self._min_count += width
+        else:
+            self._state = fn(self._state, *args, placed["valid"])
             self._min_count += int(valid_np.min())
 
     def sample_all(self, tiles: Any) -> None:
@@ -454,10 +499,18 @@ class ReservoirEngine:
         stream: Any,
         tile_width: Optional[int] = None,
         weights: Optional[Any] = None,
+        fused: bool = False,
     ) -> None:
         """Feed one ``[R, N]`` array, auto-tiled to ``config.tile_size``
         columns with a masked ragged tail — never re-jitting per remainder.
-        Weighted engines pass a parallel ``[R, N]`` ``weights`` array."""
+        Weighted engines pass a parallel ``[R, N]`` ``weights`` array.
+
+        ``fused=True`` runs every full tile inside ONE jitted ``lax.scan``
+        (one transfer + one dispatch instead of one per tile) — on tunneled
+        backends where each dispatch costs a ~30ms round-trip this is the
+        difference between wire speed and RPC-bound feeding.  Results are
+        bit-identical to the unfused path (tile-split invariance: draws are
+        keyed on absolute indices).  The ragged tail still goes per-tile."""
         self._check_open()
         stream = np.asarray(stream)
         R, N = stream.shape
@@ -465,13 +518,25 @@ class ReservoirEngine:
             if weights is None:
                 raise ValueError("weighted engine requires a weights array")
             weights = np.asarray(weights, np.float32)
+            if not np.all(weights >= 0):  # also rejects NaN; both routes
+                raise ValueError("weights must be nonnegative")
             if weights.shape != stream.shape:
                 raise ValueError(
                     f"weights must match stream shape {stream.shape}, "
                     f"got {weights.shape}"
                 )
         B = tile_width or self._config.tile_size
-        for start in range(0, N, B):
+        start0 = 0
+        if fused and N >= 2 * B and not self._wide:
+            n_full = N // B
+            self._sample_stream_fused(
+                stream[:, : n_full * B],
+                weights[:, : n_full * B] if weights is not None else None,
+                B,
+                n_full,
+            )
+            start0 = n_full * B
+        for start in range(start0, N, B):
             chunk = stream[:, start : start + B]
             wchunk = weights[:, start : start + B] if weights is not None else None
             w = chunk.shape[1]
@@ -487,6 +552,66 @@ class ReservoirEngine:
                 self.sample(chunk, np.full((R,), w, np.int32), weights=wchunk)
             else:
                 self.sample(chunk, weights=wchunk)
+
+    def _sample_stream_fused(
+        self,
+        stream: np.ndarray,
+        weights: Optional[np.ndarray],
+        B: int,
+        n_full: int,
+    ) -> None:
+        """Every full tile in one jitted ``lax.scan``: host reshapes to
+        ``[n, R, B]`` (a C-speed transpose copy), one async transfer ships
+        it, one dispatch consumes it."""
+        R = self._config.num_reservoirs
+        steady = (
+            not self._config.distinct
+            and not self._config.weighted
+            and self._min_count >= self._config.max_sample_size
+        )
+        use_pallas = self._pallas_eligible(steady, False, stream.dtype)
+        cache_key = ("stream_fused", n_full, B, steady, use_pallas,
+                     np.dtype(stream.dtype).str)
+        fn = self._jit_cache.get(cache_key)
+        if fn is None:
+            base = self._base_update(steady, use_pallas)
+            weighted = self._config.weighted
+
+            def scan_fn(state, tiles, wtiles=None):
+                def body(st, xs):
+                    if weighted:
+                        tile, wt = xs
+                        return base(st, tile, wt), None
+                    return base(st, xs), None
+
+                xs = (tiles, wtiles) if weighted else tiles
+                state, _ = jax.lax.scan(body, state, xs)
+                return state
+
+            fn = jax.jit(scan_fn, donate_argnums=(0,))
+            self._jit_cache[cache_key] = fn
+        tiles = np.ascontiguousarray(
+            stream.reshape(R, n_full, B).swapaxes(0, 1)
+        )
+        stage = {"tiles": tiles}
+        if weights is not None:
+            stage["weights"] = np.ascontiguousarray(
+                weights.reshape(R, n_full, B).swapaxes(0, 1)
+            )
+        if self._mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as _P
+
+            sh = NamedSharding(
+                self._mesh, _P(None, self._config.mesh_axis, None)
+            )
+            placed = jax.device_put(stage, {k: sh for k in stage})
+        else:
+            placed = jax.device_put(stage)
+        if weights is not None:
+            self._state = fn(self._state, placed["tiles"], placed["weights"])
+        else:
+            self._state = fn(self._state, placed["tiles"])
+        self._min_count += n_full * B
 
     # ----------------------------------------------------------- checkpoints
 

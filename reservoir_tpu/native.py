@@ -91,9 +91,10 @@ def load_library(rebuild: bool = False) -> Optional[ctypes.CDLL]:
             _load_error = f"dlopen failed: {e}"
             return None
         if _load_error and _load_error.startswith("native build failed"):
-            # a stale-but-working .so loaded: the native path IS live; keep
-            # the contract that load_error() == None means "native in use"
-            _load_error = None
+            # a stale-but-working .so loaded: the native path IS live, but
+            # it may not match the sources — keep the failure visible (the
+            # module contract: the build is never *silently* best-effort)
+            _load_error = f"running STALE .so ({_load_error})"
         return _finish_load(lib)
 
 

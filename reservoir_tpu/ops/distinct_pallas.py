@@ -216,13 +216,7 @@ def _kernel(
         )
         cand_n = cand_c & ~consumed
         # the threshold may have tightened; re-mask candidates
-        last = lane_k == (k - 1)
-        thi_n = jnp.sum(
-            jnp.where(last, out_hhi_ref[:, :], 0), axis=1, keepdims=True
-        ).astype(jnp.uint32)
-        tlo_n = jnp.sum(
-            jnp.where(last, out_hlo_ref[:, :], 0), axis=1, keepdims=True
-        ).astype(jnp.uint32)
+        thi_n, tlo_n = threshold()  # reads the just-updated out refs
         cand_n = cand_n & _lex_lt(bhhi, bhlo, thi_n, tlo_n)
         return cand_n, size_n
 

@@ -79,3 +79,49 @@ def test_device_engine_auto_dispatches_pallas():
     a, xs = engines["auto"].result_arrays(), engines["xla"].result_arrays()
     np.testing.assert_array_equal(a[0], xs[0])
     np.testing.assert_array_equal(a[1], xs[1])
+
+
+def test_device_weighted_pallas_matches_xla():
+    # M4b on hardware: Mosaic's lowering of the cumsum/searchsorted-style
+    # scan and the log/exp conditional-key chain
+    from reservoir_tpu.ops import weighted as ww
+    from reservoir_tpu.ops import weighted_pallas as wp
+
+    R, k, B = 64, 64, 256
+    state = ww.init(jr.key(3), R, k)
+    elems = jax.lax.broadcasted_iota(jnp.int32, (R, B), 1)
+    weights = jr.randint(jr.key(4), (R, B), 1, 5).astype(jnp.float32)
+    weights = weights * (jr.uniform(jr.key(5), (R, B)) > 0.2)  # zeros too
+    ref = ww.update(state, elems, weights)
+    got = wp.update_pallas(state, elems, weights)
+    np.testing.assert_array_equal(np.asarray(ref.samples), np.asarray(got.samples))
+    np.testing.assert_array_equal(np.asarray(ref.lkeys), np.asarray(got.lkeys))
+    np.testing.assert_array_equal(np.asarray(ref.count), np.asarray(got.count))
+    np.testing.assert_array_equal(np.asarray(ref.xw), np.asarray(got.xw))
+
+
+def test_device_distinct_pallas_matches_xla():
+    # M4c on hardware: the lexicographic min/insert shift machinery
+    from reservoir_tpu.ops import distinct as dd
+    from reservoir_tpu.ops import distinct_pallas as dp
+
+    R, k, B = 64, 64, 256
+    s_ref = s_pal = dd.init(jr.key(6), R, k)
+    for step in range(3):
+        batch = jr.randint(
+            jr.fold_in(jr.key(7), step), (R, B), 0, 500, jnp.int32
+        )
+        s_ref = dd.update(s_ref, batch)
+        s_pal = dp.update_pallas(s_pal, batch)
+        np.testing.assert_array_equal(
+            np.asarray(s_ref.values), np.asarray(s_pal.values)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(s_ref.hash_hi), np.asarray(s_pal.hash_hi)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(s_ref.hash_lo), np.asarray(s_pal.hash_lo)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(s_ref.size), np.asarray(s_pal.size)
+        )
