@@ -24,12 +24,15 @@ Prints exactly ONE JSON line:
 Env knobs:
   RESERVOIR_BENCH_SMOKE=1       tiny shapes for a CPU smoke run
   RESERVOIR_BENCH_CONFIG        algl (default) | distinct | weighted |
-                                bridge | stream
+                                bridge | stream | host
                                 (bridge = incremental host-feed: interleaved
                                 demux -> staging -> per-flush dispatches;
                                 stream = fused host-feed: one scanned
                                 dispatch over a host [R, N] array — the two
-                                ends of SURVEY §7.3's host-path spectrum)
+                                ends of SURVEY §7.3's host-path spectrum;
+                                host = the CPU oracle over a 1M in-memory
+                                stream, BASELINE config 1 — never touches
+                                the device backend)
   RESERVOIR_BENCH_IMPL          auto (default) | xla | pallas   (all three
                                 modes; auto tries the Pallas kernel on TPU
                                 and falls back to the XLA path if Mosaic
@@ -88,7 +91,7 @@ def _probe_backend(timeout_s: float) -> bool:
 
 
 def _init_backend_with_retry(
-    attempts: int = 6, first_delay_s: float = 5.0, probe_timeout_s: float = 90.0
+    attempts: int = 7, first_delay_s: float = 5.0, probe_timeout_s: float = 60.0
 ) -> str:
     """Touch the backend, retrying transient tunnel failures.
 
@@ -96,8 +99,9 @@ def _init_backend_with_retry(
     for reasons that clear in seconds (VERDICT r1: one such hiccup erased the
     round's official number) — or hang outright.  Each attempt first probes
     liveness in a subprocess (hang-proof), then initializes in-process only
-    once a probe has succeeded.  Bounded exponential backoff: 5+10+20+40+80s
-    worst case between attempts."""
+    once a probe has succeeded.  Exponential backoff capped at 90s between
+    attempts (~11 min worst case incl. hung probes) — then a fast, clearly
+    worded exit, never an in-process init that can hang."""
     if os.environ.get("RESERVOIR_BENCH_PLATFORM"):
         # explicitly pinned platform (e.g. cpu): init cannot hang, and the
         # probe subprocess would touch the *default* backend instead
@@ -124,11 +128,15 @@ def _init_backend_with_retry(
             file=sys.stderr,
         )
         time.sleep(delay)
-        delay *= 2
-    # all probes failed — last resort: init in-process and let the error
-    # surface (the driver's tail then shows the true cause)
-    devices = jax.devices()
-    return devices[0].platform
+        delay = min(delay * 2, 90.0)
+    # every probe failed or hung over ~10 minutes of backoff — fail FAST
+    # with a clear cause instead of attempting an in-process init that can
+    # hang and eat the caller's entire timeout (observed multi-hour tunnel
+    # outages present exactly that way)
+    raise SystemExit(
+        f"bench: backend unreachable after {attempts} probe attempts "
+        "(tunnel down?); refusing in-process init, which can hang"
+    )
 
 
 def _readback_barrier(state) -> int:
@@ -211,6 +219,24 @@ def _bench_bridge(S, k, B, steps, reps):
     for _ in range(reps):
         t0 = time.perf_counter()
         one_pass()
+        times.append(time.perf_counter() - t0)
+    return times
+
+
+def _bench_host(R, k, B, steps, reps):
+    """BASELINE config 1: the CPU host sampler over an in-memory int64
+    stream (``Sampler[Long,Long](k=128)`` over a 1M iterator) — the
+    skip-jump bulk path of the semantic oracle.  No device involved."""
+    from reservoir_tpu.api import sampler
+
+    n = R * B * steps
+    arr = np.arange(n, dtype=np.int64)
+    times = []
+    for r in range(reps):
+        s = sampler(k, rng=r)
+        t0 = time.perf_counter()
+        s.sample_all(arr)
+        s.result()
         times.append(time.perf_counter() - t0)
     return times
 
@@ -312,10 +338,12 @@ def main() -> None:
     smoke = os.environ.get("RESERVOIR_BENCH_SMOKE") == "1"
     config = os.environ.get("RESERVOIR_BENCH_CONFIG", "algl")
     impl = os.environ.get("RESERVOIR_BENCH_IMPL", "auto")
-    if config not in ("algl", "distinct", "weighted", "bridge", "stream"):
+    if config not in (
+        "algl", "distinct", "weighted", "bridge", "stream", "host"
+    ):
         raise SystemExit(
             "RESERVOIR_BENCH_CONFIG must be algl|distinct|weighted|bridge|"
-            f"stream, got {config!r}"
+            f"stream|host, got {config!r}"
         )
     if impl not in ("auto", "xla", "pallas"):
         raise SystemExit(
@@ -325,19 +353,27 @@ def main() -> None:
         "algl": (1024 if smoke else 65536, 128, 256 if smoke else 2048),
         "distinct": (256 if smoke else 4096, 32 if smoke else 256, 1024),
         "weighted": (512 if smoke else 16384, 64, 1024),
-        "bridge": (64 if smoke else 1024, 128, 128 if smoke else 1024),
+        # bridge tiles are wide (B=4096): each flush pays fixed round-trip
+        # latency on tunneled backends, so per-flush volume is the lever
+        "bridge": (64 if smoke else 1024, 128, 128 if smoke else 4096),
         "stream": (64 if smoke else 1024, 128, 128 if smoke else 2048),
+        "host": (1, 128, 50_000 if smoke else 1_000_000),  # BASELINE config 1
     }[config]
     R = int(os.environ.get("RESERVOIR_BENCH_R", defaults[0]))
     k = int(os.environ.get("RESERVOIR_BENCH_K", defaults[1]))
     B = int(os.environ.get("RESERVOIR_BENCH_B", defaults[2]))
-    default_steps = {"bridge": 2 if smoke else 4, "stream": 2 if smoke else 16}.get(
-        config, 5 if smoke else 50
-    )
+    default_steps = {
+        "bridge": 2 if smoke else 4,
+        "stream": 2 if smoke else 16,
+        "host": 1,
+    }.get(config, 5 if smoke else 50)
     steps = int(os.environ.get("RESERVOIR_BENCH_STEPS", default_steps))
     reps = int(os.environ.get("RESERVOIR_BENCH_REPS", 3))
 
-    platform = _init_backend_with_retry()
+    if config == "host":
+        platform = "cpu-host"  # pure host path; never touch the backend
+    else:
+        platform = _init_backend_with_retry()
     print(f"bench: backend ready ({platform})", file=sys.stderr)
 
     from reservoir_tpu.utils.tracing import maybe_profile
@@ -376,8 +412,10 @@ def main() -> None:
         elif config == "weighted":
             times, tag = _run_with_impl(_bench_weighted, "weighted")
         elif config == "stream":
-            times = _bench_stream(R, k, B, steps, reps, impl)
-            tag = f"stream_fused_host_feed_{impl}"
+            times, tag = _run_with_impl(_bench_stream, "stream_fused_host_feed")
+        elif config == "host":
+            times = _bench_host(R, k, B, steps, reps)
+            tag = "host_oracle"
         else:
             times = _bench_bridge(R, k, B, steps, reps)
             tag = "bridge_host_feed"

@@ -364,6 +364,12 @@ class ReservoirEngine:
                 # on tunneled backends it transfers synchronously in chunks
                 # (measured 228ms vs 2.5ms pipelined for a 4MB tile).
                 tile_host = np.array(tile, copy=True)
+                canon = jax.dtypes.canonicalize_dtype(tile_host.dtype)
+                if tile_host.dtype != canon:
+                    # canonicalize on host (int64 -> int32 with x64 off):
+                    # halves the transfer AND keeps the Pallas dispatch
+                    # probe seeing the dtype the device will actually hold
+                    tile_host = tile_host.astype(canon)
                 tile_probe = tile_host
             else:
                 tile_probe = tile
@@ -518,8 +524,6 @@ class ReservoirEngine:
             if weights is None:
                 raise ValueError("weighted engine requires a weights array")
             weights = np.asarray(weights, np.float32)
-            if not np.all(weights >= 0):  # also rejects NaN; both routes
-                raise ValueError("weights must be nonnegative")
             if weights.shape != stream.shape:
                 raise ValueError(
                     f"weights must match stream shape {stream.shape}, "
@@ -564,6 +568,14 @@ class ReservoirEngine:
         ``[n, R, B]`` (a C-speed transpose copy), one async transfer ships
         it, one dispatch consumes it."""
         R = self._config.num_reservoirs
+        if weights is not None and not np.all(weights >= 0):
+            # the unfused route validates per tile inside sample(); this
+            # route ships straight to the scan (also rejects NaN)
+            raise ValueError("weights must be nonnegative")
+        if not self._wide:
+            canon = jax.dtypes.canonicalize_dtype(stream.dtype)
+            if stream.dtype != canon:
+                stream = stream.astype(canon)  # pre-transfer, like sample()
         steady = (
             not self._config.distinct
             and not self._config.weighted
@@ -593,11 +605,19 @@ class ReservoirEngine:
         tiles = np.ascontiguousarray(
             stream.reshape(R, n_full, B).swapaxes(0, 1)
         )
+        if np.shares_memory(tiles, stream):
+            # R == 1 makes the transpose a no-op view of the CALLER's
+            # buffer — snapshot before the async device_put (the same
+            # contract sample() keeps with np.array(copy=True))
+            tiles = tiles.copy()
         stage = {"tiles": tiles}
         if weights is not None:
-            stage["weights"] = np.ascontiguousarray(
+            wtiles = np.ascontiguousarray(
                 weights.reshape(R, n_full, B).swapaxes(0, 1)
             )
+            if np.shares_memory(wtiles, weights):
+                wtiles = wtiles.copy()
+            stage["weights"] = wtiles
         if self._mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec as _P
 

@@ -109,3 +109,64 @@ def test_distinct_mode_ks_uniform_over_distinct_values():
     values = np.asarray(samples).ravel()
     ks = _ks_one_sample_uniform(values, n)
     assert ks < GATE, f"distinct KS vs uniform = {ks:.4f}"
+
+
+def test_weighted_mode_ks_uniform_when_weights_equal():
+    # Equal weights degrade A-ExpJ to uniform sampling: the pooled sampled
+    # values must pass the same 1% KS gate as Algorithm L.  Pool
+    # N = R*k = 65,536 -> null 95th pct ~0.0053, false-fail ~4e-6.
+    from reservoir_tpu.ops import weighted as ww
+
+    R, k, n, B = 2048, 32, 4096, 512
+    state = ww.init(jr.key(3), R, k)
+    fn = jax.jit(ww.update, donate_argnums=0)
+    for start in range(0, n, B):
+        batch = start + jax.lax.broadcasted_iota(jnp.int32, (R, B), 1)
+        state = fn(state, batch, jnp.ones((R, B), jnp.float32))
+    samples, sizes = ww.result(state)
+    assert int(np.asarray(sizes).min()) == k
+    ks = _ks_one_sample_uniform(np.asarray(samples).ravel(), n)
+    assert ks < GATE, f"weighted(equal) KS vs uniform = {ks:.4f}"
+
+
+def test_weighted_mode_skew_matches_naive_oracle():
+    # Two weight classes (1 vs 4): the heavy class's pooled inclusion rate
+    # from the device A-ExpJ kernel must match the exact A-ES ground truth
+    # (NaiveWeightedOracle) within 5 sigma of the binomial null.
+    from reservoir_tpu.oracle.weighted import NaiveWeightedOracle
+    from reservoir_tpu.ops import weighted as ww
+
+    R, k, n = 4096, 8, 256
+    weights_row = np.where(np.arange(n) % 4 == 0, 4.0, 1.0).astype(np.float32)
+
+    state = ww.init(jr.key(4), R, k)
+    fn = jax.jit(ww.update, donate_argnums=0)
+    state = fn(
+        state,
+        jax.lax.broadcasted_iota(jnp.int32, (R, n), 1),
+        jnp.tile(jnp.asarray(weights_row), (R, 1)),
+    )
+    samples, sizes = ww.result(state)
+    assert int(np.asarray(sizes).min()) == k
+    dev_vals = np.asarray(samples).ravel()
+    dev_heavy = float(np.mean(dev_vals % 4 == 0))
+
+    rng = np.random.default_rng(11)
+    trials = 1024
+    cpu_heavy_cnt = 0
+    for _ in range(trials):
+        o = NaiveWeightedOracle(k, rng)
+        for v in range(n):
+            o.sample(v, float(weights_row[v]))
+        res = np.asarray(o.result())
+        cpu_heavy_cnt += int(np.sum(res % 4 == 0))
+    cpu_heavy = cpu_heavy_cnt / (trials * k)
+
+    # both estimates are means of R*k (resp. trials*k) Bernoulli draws;
+    # gate the difference at 5 sigma of the combined null
+    p = cpu_heavy
+    sigma = np.sqrt(p * (1 - p) * (1 / (R * k) + 1 / (trials * k)))
+    assert abs(dev_heavy - cpu_heavy) < 5 * sigma, (
+        f"heavy-class inclusion: device {dev_heavy:.4f} vs "
+        f"oracle {cpu_heavy:.4f} (5 sigma = {5 * sigma:.4f})"
+    )
