@@ -363,13 +363,19 @@ class ReservoirEngine:
                 # call ship in ONE async transfer below.  Never jnp.asarray:
                 # on tunneled backends it transfers synchronously in chunks
                 # (measured 228ms vs 2.5ms pipelined for a 4MB tile).
-                tile_host = np.array(tile, copy=True)
+                tile_host = np.asarray(tile)
                 canon = jax.dtypes.canonicalize_dtype(tile_host.dtype)
                 if tile_host.dtype != canon:
                     # canonicalize on host (int64 -> int32 with x64 off):
                     # halves the transfer AND keeps the Pallas dispatch
-                    # probe seeing the dtype the device will actually hold
+                    # probe seeing the dtype the device will actually hold;
+                    # astype already yields a fresh snapshot buffer
                     tile_host = tile_host.astype(canon)
+                elif tile_host is tile or tile_host.base is not None:
+                    # caller handed us an ndarray, a view, or a wrapped
+                    # buffer: snapshot it — asarray of a list/tuple is
+                    # already a fresh buffer and needs no second copy
+                    tile_host = tile_host.copy()
                 tile_probe = tile_host
             else:
                 tile_probe = tile
@@ -529,6 +535,11 @@ class ReservoirEngine:
                     f"weights must match stream shape {stream.shape}, "
                     f"got {weights.shape}"
                 )
+            # validate the WHOLE array before consuming any tile: a bad
+            # weight in tile i must not leave tiles 0..i-1 already folded
+            # into the reservoir state (callers could not roll back)
+            if not np.all(weights >= 0):
+                raise ValueError("weights must be nonnegative")
         B = tile_width or self._config.tile_size
         start0 = 0
         if fused and N >= 2 * B and not self._wide:
@@ -568,10 +579,8 @@ class ReservoirEngine:
         ``[n, R, B]`` (a C-speed transpose copy), one async transfer ships
         it, one dispatch consumes it."""
         R = self._config.num_reservoirs
-        if weights is not None and not np.all(weights >= 0):
-            # the unfused route validates per tile inside sample(); this
-            # route ships straight to the scan (also rejects NaN)
-            raise ValueError("weights must be nonnegative")
+        # weights were already validated whole-array (incl. NaN rejection)
+        # by sample_stream, the sole caller
         if not self._wide:
             canon = jax.dtypes.canonicalize_dtype(stream.dtype)
             if stream.dtype != canon:

@@ -46,6 +46,7 @@ import numpy as np
 
 from .distinct import DistinctState
 from .hashing import scramble64
+from .prefix import lane_cumsum
 
 __all__ = ["supports", "update_pallas"]
 
@@ -77,6 +78,32 @@ def _sign_extend_hi(lo_bits):
 def _lex_lt(ahi, alo, bhi, blo):
     """(ahi, alo) < (bhi, blo) as 64-bit lexicographic uint compare."""
     return (ahi < bhi) | ((ahi == bhi) & (alo < blo))
+
+
+# Mosaic has no reductions over unsigned integers (NotImplementedError in
+# lowering, observed on TPU 2026-07-30) — every uint32 reduction below goes
+# through int32 bit patterns instead.
+
+_SIGN = np.uint32(0x80000000)
+
+
+def _umin_where(mask, x):
+    """Masked per-row unsigned min of uint32 ``x`` (empty rows -> MAX):
+    flip the sign bit so unsigned order becomes signed order, reduce in
+    int32, flip back."""
+    xs = jax.lax.bitcast_convert_type(x ^ _SIGN, jnp.int32)
+    m = jnp.min(
+        jnp.where(mask, xs, jnp.int32(0x7FFFFFFF)), axis=1, keepdims=True
+    )
+    return jax.lax.bitcast_convert_type(m, jnp.uint32) ^ _SIGN
+
+
+def _usel(mask, x):
+    """Gather the single masked lane of uint32 ``x`` per row (sum of int32
+    bit patterns; exact because at most one lane is unmasked)."""
+    xi = jax.lax.bitcast_convert_type(x, jnp.int32)
+    s = jnp.sum(jnp.where(mask, xi, 0), axis=1, keepdims=True)
+    return jax.lax.bitcast_convert_type(s, jnp.uint32)
 
 
 def _kernel(
@@ -124,41 +151,33 @@ def _kernel(
     # block (padding IS (MAX, MAX))
     def threshold():
         last = lane_k == (k - 1)
-        thi = jnp.sum(jnp.where(last, out_hhi_ref[:, :], 0), axis=1, keepdims=True)
-        tlo = jnp.sum(jnp.where(last, out_hlo_ref[:, :], 0), axis=1, keepdims=True)
-        return thi.astype(jnp.uint32), tlo.astype(jnp.uint32)
+        thi = _usel(last, out_hhi_ref[:, :])
+        tlo = _usel(last, out_hlo_ref[:, :])
+        return thi, tlo
 
     thi, tlo = threshold()
     cand = _lex_lt(bhhi, bhlo, thi, tlo)  # [r, B]
 
+    # the while_loop carries the candidate mask as int32, not bool: Mosaic
+    # cannot yield i1 vectors from scf loops (failed-to-legalize on TPU,
+    # observed 2026-07-30)
     def cond(carry):
-        cand_c, _ = carry
-        return jnp.any(cand_c)
+        cand_i, _ = carry
+        return jnp.any(cand_i != 0)
 
     def body(carry):
-        cand_c, size_c = carry
+        cand_i, size_c = carry
+        cand_c = cand_i != 0
         active = jnp.any(cand_c, axis=1, keepdims=True)  # [r, 1]
         # minimum candidate hash, lexicographic over (hi, lo)
-        mhi = jnp.min(
-            jnp.where(cand_c, bhhi, np.uint32(0xFFFFFFFF)), axis=1, keepdims=True
-        )
+        mhi = _umin_where(cand_c, bhhi)
         is_mhi = cand_c & (bhhi == mhi)
-        mlo = jnp.min(
-            jnp.where(is_mhi, bhlo, np.uint32(0xFFFFFFFF)), axis=1, keepdims=True
-        )
+        mlo = _umin_where(is_mhi, bhlo)
         hit = is_mhi & (bhlo == mlo)
         # first tile lane carrying (mhi, mlo): its value bits
-        first = hit & (jnp.cumsum(hit.astype(jnp.int32), axis=1) == 1)
-        vlo = jnp.sum(
-            jnp.where(first, bvlo_ref[:, :], jnp.uint32(0)),
-            axis=1,
-            keepdims=True,
-        ).astype(jnp.uint32)
-        vhi = jnp.sum(
-            jnp.where(first, bvhi_ref[:, :], jnp.uint32(0)),
-            axis=1,
-            keepdims=True,
-        ).astype(jnp.uint32)
+        first = hit & (lane_cumsum(hit.astype(jnp.int32)) == 1)
+        vlo = _usel(first, bvlo_ref[:, :])
+        vhi = _usel(first, bvhi_ref[:, :])
         # dedup: (hash, value) already resident?
         ehhi = out_hhi_ref[:, :]
         ehlo = out_hlo_ref[:, :]
@@ -218,9 +237,11 @@ def _kernel(
         # the threshold may have tightened; re-mask candidates
         thi_n, tlo_n = threshold()  # reads the just-updated out refs
         cand_n = cand_n & _lex_lt(bhhi, bhlo, thi_n, tlo_n)
-        return cand_n, size_n
+        return cand_n.astype(jnp.int32), size_n
 
-    _, size = jax.lax.while_loop(cond, body, (cand, size_ref[:, :]))
+    _, size = jax.lax.while_loop(
+        cond, body, (cand.astype(jnp.int32), size_ref[:, :])
+    )
     out_size_ref[:, :] = size
 
 

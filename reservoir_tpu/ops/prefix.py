@@ -1,0 +1,33 @@
+"""Shared prefix-sum used by both the XLA paths and the Pallas kernels.
+
+Mosaic has no lowering for the ``cumsum`` primitive (NotImplementedError on
+TPU, observed 2026-07-30), so Pallas kernels cannot call ``jnp.cumsum``.
+This log-step shifted-add scan (Hillis-Steele) lowers everywhere.  For float
+inputs the summation *association* determines the rounded partial sums, so
+any path that must stay bit-identical to a Pallas kernel (the weighted
+A-ExpJ weight cumsum — ``ops.weighted`` vs ``ops.weighted_pallas``) uses
+this same helper rather than ``jnp.cumsum``: identical decomposition ==
+identical floats, on every backend.  Integer scans are exact under any
+association; Pallas kernels still use this helper for them (no cumsum
+primitive), while XLA-only integer scans keep ``jnp.cumsum``.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["lane_cumsum"]
+
+
+def lane_cumsum(x: jax.Array, axis: int = -1) -> jax.Array:
+    """Inclusive prefix sum along ``axis`` via log2(n) shifted adds."""
+    axis = axis % x.ndim
+    n = x.shape[axis]
+    d = 1
+    while d < n:
+        kept = jax.lax.slice_in_dim(x, 0, n - d, axis=axis)
+        zeros = jnp.zeros_like(jax.lax.slice_in_dim(x, 0, d, axis=axis))
+        x = x + jnp.concatenate([zeros, kept], axis=axis)
+        d *= 2
+    return x

@@ -44,6 +44,7 @@ import jax.numpy as jnp
 import jax.random as jr
 import numpy as np
 
+from .prefix import lane_cumsum
 from .rng import uniforms as rng_uniforms
 
 __all__ = ["WeightedState", "init", "update", "update_steady", "result", "merge"]
@@ -110,7 +111,10 @@ def _update_one(
     wf = weights.astype(jnp.float32)
     positive = in_tile & (wf > 0.0)  # zero-weight: counted, never sampled
     w_masked = jnp.where(in_tile, wf, 0.0)
-    cw = jnp.cumsum(w_masked)
+    # lane_cumsum, not jnp.cumsum: the Pallas kernel must reproduce these
+    # partial sums bit-for-bit, and Mosaic has no cumsum primitive — both
+    # paths share the one log-step association (ops.prefix)
+    cw = lane_cumsum(w_masked)
     total_w = jnp.where(valid > 0, cw[bsz - 1], 0.0)
     # filled slots are a prefix by construction; -inf lkey == empty slot
     # (fill keys are clamped finite below so the sentinel is unambiguous)
@@ -150,13 +154,23 @@ def _update_one(
     start = jnp.where(need > 0, jnp.minimum(j0 + 1, bsz), 0).astype(jnp.int32)
     base0 = jnp.where(start > 0, cw[jnp.maximum(start - 1, 0)], 0.0)
 
+    lane = jnp.arange(bsz, dtype=jnp.int32)
+
     def next_j(base, xw_c, cur):
-        j = jnp.searchsorted(cw, base + xw_c, side="left").astype(jnp.int32)
-        return jnp.maximum(j, cur)
+        # first POSITIVE lane at or past ``cur`` whose prefix weight reaches
+        # the jump target.  Under exact partial sums this is exactly
+        # ``searchsorted(cw, base+xw, 'left')`` clamped to ``cur`` — but the
+        # shared log-step prefix sum (ops.prefix) has ulp-scale dips, under
+        # which a raw searchsorted could land on a zero-weight lane and the
+        # accept body would then compute log(1)/0 = NaN.  Restricting to
+        # positive lanes makes the scan NaN-free by construction, and the
+        # integer min is reproduced bit-for-bit by the Pallas kernel.
+        mask = positive & (cw >= base + xw_c) & (lane >= cur)
+        return jnp.min(jnp.where(mask, lane, bsz)).astype(jnp.int32)
 
     def cond(carry):
         _, _, xw_c, base, cur = carry
-        return next_j(base, xw_c, cur) < valid
+        return next_j(base, xw_c, cur) < bsz
 
     def body(carry):
         samples_c, lkeys_c, xw_c, base, cur = carry

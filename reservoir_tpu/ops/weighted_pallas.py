@@ -33,6 +33,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from .prefix import lane_cumsum
 from .rng import key_words, uniform_from_bits
 from .threefry import counter_bits
 from .weighted import WeightedState, _NEG_INF, _draw_xw
@@ -98,13 +99,13 @@ def _kernel(
 
     wf = weights_ref[:, :]  # [r, B] f32
     positive = wf > 0.0
-    cw = jnp.cumsum(wf, axis=1)  # [r, B]
+    cw = lane_cumsum(wf)  # [r, B]; same association as the XLA path
     total_w = cw[:, block_b - 1 : block_b]  # [r, 1]
     n_filled = jnp.sum(
         (lkeys_ref[:, :] > _NEG_INF).astype(jnp.int32), axis=1, keepdims=True
     )
     need = jnp.maximum(k - n_filled, 0)  # [r, 1]
-    prank = jnp.cumsum(positive.astype(jnp.int32), axis=1)  # [r, B]
+    prank = lane_cumsum(positive.astype(jnp.int32))  # [r, B]
     idx_abs = count + lane_b + 1  # [r, B] absolute 1-based
 
     # ---- fill phase (positive items take the next free slots in order) ----
@@ -173,9 +174,14 @@ def _kernel(
     )
 
     def next_j(base, xw_c, cur):
+        # first positive lane at or past cur reaching the jump target —
+        # the same integer min as ops.weighted.next_j (NaN-free under the
+        # shared prefix sum's ulp dips; see the comment there)
         x = base + xw_c  # [r, 1]
-        j = jnp.sum((cw < x).astype(jnp.int32), axis=1, keepdims=True)
-        return jnp.maximum(j, cur)
+        mask = positive & (cw >= x) & (lane_b >= cur)
+        return jnp.min(
+            jnp.where(mask, lane_b, block_b), axis=1, keepdims=True
+        )
 
     def cond(carry):
         xw_c, base, cur = carry
@@ -187,10 +193,10 @@ def _kernel(
         active = j < block_b
         onehot_j = lane_b == j  # empty when j == block_b
         w_c = jnp.sum(jnp.where(onehot_j, wf, 0.0), axis=1, keepdims=True)
-        # the crossing item always has w > 0 (flat cumsum spans can't be
-        # crossed), so active lanes use the raw weight — bit-identical to
-        # the XLA path even for subnormal weights; inactive lanes get 1.0
-        # purely to avoid masked NaNs that would trip jax_debug_nans
+        # next_j only returns positive-weight lanes, so active lanes use
+        # the raw weight — bit-identical to the XLA path even for subnormal
+        # weights; inactive lanes get 1.0 purely to avoid masked NaNs that
+        # would trip jax_debug_nans
         w_safe = jnp.where(active, w_c, 1.0)
         e_bits = _row_gather_bits(onehot_j, elem_bits_all)
         idx = count + 1 + j
@@ -207,7 +213,7 @@ def _kernel(
         )
         # argmin with first-match tie-breaking (jnp.argmin semantics)
         is_min = lkeys_c == lt
-        first_min = is_min & (jnp.cumsum(is_min.astype(jnp.int32), axis=1) == 1)
+        first_min = is_min & (lane_cumsum(is_min.astype(jnp.int32)) == 1)
         write = first_min & active
         out_samples_ref[:, :] = jnp.where(
             write,
