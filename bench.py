@@ -264,9 +264,7 @@ def _bench_stream(R, k, B, steps, reps, impl="auto"):
 
     one_pass()  # warm: compiles the fill-regime scan
     one_pass()  # warm: compiles the steady-regime scan (the timed regime)
-    if impl == "pallas" and not any(
-        k[0] == "stream_fused" and k[4] for k in eng._jit_cache
-    ):
+    if impl == "pallas" and not eng.pallas_used():
         # the engine's dispatch declines silently (_pallas_eligible); an
         # XLA run must not be recorded under a pallas-tagged metric — raise
         # so auto's fallback relabels it

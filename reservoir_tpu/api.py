@@ -214,8 +214,12 @@ def sampler(
     is applied on accept and may be called more than ``k`` times
     (``Sampler.scala:116``).  ``rng`` may be a seed or a ``numpy`` Generator.
     """
-    map_fn = map_fn if map_fn is not None else _identity
-    validate_non_distinct_params(max_sample_size, map_fn)
+    # validate with an explicit identity but hand the oracle the user's
+    # map_fn as given: None tells it the map is identity, unlocking the
+    # native bulk scan (oracle/algorithm_l.py module docs)
+    validate_non_distinct_params(
+        max_sample_size, map_fn if map_fn is not None else _identity
+    )
     engine = AlgorithmLOracle(
         max_sample_size, _resolve_rng(rng), map_fn=map_fn, pre_allocate=pre_allocate
     )

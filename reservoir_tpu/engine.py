@@ -180,12 +180,27 @@ class ReservoirEngine:
         # fill vs steady dispatch with no device readback.
         self._min_count = 0
         self._jit_cache: dict = {}
+        # set by sample_stream around its per-tile loop after it validated
+        # the whole weights array, so sample() skips the per-tile re-scan
+        self._weights_prevalidated = False
 
     # ------------------------------------------------------------ properties
 
     @property
     def config(self) -> SamplerConfig:
         return self._config
+
+    def pallas_used(self) -> bool:
+        """True iff any update compiled so far dispatched to a Pallas
+        kernel.  Owns the jit-cache key layouts (per-tile keys are
+        ``(width, steady, ragged, use_pallas)``, fused-stream keys are
+        ``("stream_fused", n, B, steady, use_pallas, dtype)``) so callers
+        (bench.py's impl-tag guard, dispatch tests) never probe them
+        positionally."""
+        return any(
+            (key[4] if key[0] == "stream_fused" else key[3])
+            for key in self._jit_cache
+        )
 
     @property
     def is_open(self) -> bool:
@@ -371,10 +386,11 @@ class ReservoirEngine:
                     # probe seeing the dtype the device will actually hold;
                     # astype already yields a fresh snapshot buffer
                     tile_host = tile_host.astype(canon)
-                elif tile_host is tile or tile_host.base is not None:
-                    # caller handed us an ndarray, a view, or a wrapped
-                    # buffer: snapshot it — asarray of a list/tuple is
-                    # already a fresh buffer and needs no second copy
+                elif not isinstance(tile, (list, tuple)):
+                    # snapshot: ndarrays/views alias the caller's buffer,
+                    # and __array__-protocol wrappers may hand out their
+                    # live internal array — only builtin sequences are
+                    # guaranteed fresh from asarray and skip the copy
                     tile_host = tile_host.copy()
                 tile_probe = tile_host
             else:
@@ -400,7 +416,9 @@ class ReservoirEngine:
             if not isinstance(weights, jax.Array):
                 w_in = weights
                 weights_host = np.asarray(w_in, np.float32)
-                if not np.all(weights_host >= 0):
+                if not self._weights_prevalidated and not np.all(
+                    weights_host >= 0
+                ):
                     raise ValueError("weights must be nonnegative")
                 if weights_host is w_in:
                     # no conversion copy happened — snapshot before the
@@ -551,22 +569,32 @@ class ReservoirEngine:
                 n_full,
             )
             start0 = n_full * B
-        for start in range(start0, N, B):
-            chunk = stream[:, start : start + B]
-            wchunk = weights[:, start : start + B] if weights is not None else None
-            w = chunk.shape[1]
-            if w < B:
-                pad = np.zeros((R, B - w), chunk.dtype)
-                chunk = np.concatenate([chunk, pad], axis=1)
-                if wchunk is not None:
-                    # padding weight 1.0 keeps the positivity contract; the
-                    # valid mask excludes the padding from sampling anyway
-                    wchunk = np.concatenate(
-                        [wchunk, np.ones((R, B - w), np.float32)], axis=1
+        self._weights_prevalidated = weights is not None
+        try:
+            for start in range(start0, N, B):
+                chunk = stream[:, start : start + B]
+                wchunk = (
+                    weights[:, start : start + B]
+                    if weights is not None
+                    else None
+                )
+                w = chunk.shape[1]
+                if w < B:
+                    pad = np.zeros((R, B - w), chunk.dtype)
+                    chunk = np.concatenate([chunk, pad], axis=1)
+                    if wchunk is not None:
+                        # padding weight 1.0 keeps the positivity contract;
+                        # the valid mask excludes the padding from sampling
+                        wchunk = np.concatenate(
+                            [wchunk, np.ones((R, B - w), np.float32)], axis=1
+                        )
+                    self.sample(
+                        chunk, np.full((R,), w, np.int32), weights=wchunk
                     )
-                self.sample(chunk, np.full((R,), w, np.int32), weights=wchunk)
-            else:
-                self.sample(chunk, weights=wchunk)
+                else:
+                    self.sample(chunk, weights=wchunk)
+        finally:
+            self._weights_prevalidated = False
 
     def _sample_stream_fused(
         self,

@@ -25,7 +25,7 @@ from typing import Optional
 
 import numpy as np
 
-__all__ = ["NativeStaging", "load_library", "load_error"]
+__all__ = ["NativeStaging", "load_library", "load_error", "algl_scan"]
 
 _NATIVE_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "_native")
 _SO_PATH = os.path.join(_NATIVE_DIR, "libreservoir_host.so")
@@ -142,8 +142,61 @@ def _finish_load(lib: ctypes.CDLL) -> ctypes.CDLL:
             ctypes.POINTER(ctypes.c_int32),
             ctypes.c_int32,
         ]
+    if hasattr(lib, "reservoir_algl_scan"):  # absent only in a stale .so
+        lib.reservoir_algl_scan.restype = ctypes.c_int64
+        lib.reservoir_algl_scan.argtypes = [
+            ctypes.c_void_p,  # next_double function pointer
+            ctypes.c_void_p,  # bit-generator state
+            ctypes.c_void_p,  # elems
+            ctypes.c_int64,  # n
+            ctypes.c_int64,  # k
+            ctypes.c_void_p,  # samples (in/out)
+            ctypes.c_int64,  # count
+            ctypes.c_int64,  # next acceptance (absolute, 1-based)
+            ctypes.c_double,  # log_w
+            ctypes.POINTER(ctypes.c_double),  # log_w out
+            ctypes.POINTER(ctypes.c_int64),  # next out
+        ]
     _lib = lib
     return _lib
+
+
+def algl_scan(rng, elems: np.ndarray, k: int, samples: np.ndarray,
+              count: int, next_acc: int, log_w: float):
+    """Steady-state Algorithm-L skip-jump scan in C, drawing from ``rng``'s
+    own bit stream (numpy's documented BitGenerator ctypes interface) so the
+    result is bit-identical to the Python path under one seed.
+
+    Mutates ``samples`` (int64[k]) in place; returns
+    ``(count, next_acc, log_w)`` after the scan, or None when the native
+    library (or the generator's ctypes interface) is unavailable — callers
+    fall back to the Python loop.
+    """
+    lib = load_library()
+    if lib is None or not hasattr(lib, "reservoir_algl_scan"):
+        return None
+    try:
+        iface = rng.bit_generator.ctypes
+        fn_ptr = ctypes.cast(iface.next_double, ctypes.c_void_p)
+        state = iface.state_address
+    except AttributeError:
+        return None
+    log_w_out = ctypes.c_double()
+    next_out = ctypes.c_int64()
+    new_count = lib.reservoir_algl_scan(
+        fn_ptr,
+        ctypes.c_void_p(state),
+        elems.ctypes.data_as(ctypes.c_void_p),
+        elems.size,
+        k,
+        samples.ctypes.data_as(ctypes.c_void_p),
+        count,
+        next_acc,
+        log_w,
+        ctypes.byref(log_w_out),
+        ctypes.byref(next_out),
+    )
+    return int(new_count), int(next_out.value), float(log_w_out.value)
 
 
 class NativeStaging:

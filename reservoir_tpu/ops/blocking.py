@@ -1,0 +1,42 @@
+"""Row-block sizing shared by the Pallas kernels.
+
+Mosaic grid cells run sequentially on the TensorCore, so per-cell overhead
+is amortized by wider reservoir row-blocks — but each cell's working set
+(state block + batch block + elementwise temps) must fit VMEM.  Measured on
+v5e (BENCH.md sweep, 2026-07-30): the distinct config gains 2.1x going from
+block 8 to 128; the weighted config gains 1.2x from 64 to 128 and fails to
+allocate at 256.  ``pick_block_r`` returns the largest power-of-2 divisor
+of R that stays under both the measured cap (128) and a per-kernel VMEM
+budget, from a caller-supplied bytes-per-row estimate.
+"""
+
+from __future__ import annotations
+
+__all__ = ["pick_block_r"]
+
+_MAX_BLOCK_R = 128
+# half of v5e's ~16 MiB VMEM, leaving the rest for Mosaic's own temporaries
+# and double-buffering; block 256 at the weighted bench shape (~8.4 MB by
+# its estimate) is the measured allocation failure this budget excludes
+_VMEM_BUDGET_BYTES = 6 * 1024 * 1024
+
+
+def pick_block_r(num_reservoirs: int, row_bytes: int, min_block: int) -> int:
+    """Largest power-of-2 divisor of R with ``block * row_bytes`` under the
+    VMEM budget, capped at ``_MAX_BLOCK_R``.  ``row_bytes`` is the kernel's
+    estimate of per-reservoir-row VMEM traffic (state + batch + temps).
+
+    Never returns below ``min_block`` (the kernel's declared minimum grid
+    block, which ``supports()`` guarantees divides R): a huge-tile shape
+    whose budget math would suggest a sub-minimum block gets exactly the
+    fixed block the kernel ran with before auto-sizing existed — the VMEM
+    budget only ever *widens* blocks, it cannot un-meet the gate.
+    """
+    b = min_block
+    while (
+        b < _MAX_BLOCK_R
+        and num_reservoirs % (b * 2) == 0
+        and (b * 2) * row_bytes <= _VMEM_BUDGET_BYTES
+    ):
+        b *= 2
+    return b

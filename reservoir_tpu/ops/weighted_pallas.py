@@ -38,27 +38,41 @@ from .rng import key_words, uniform_from_bits
 from .threefry import counter_bits
 from .weighted import WeightedState, _NEG_INF, _draw_xw
 
-__all__ = ["supports", "update_pallas"]
+__all__ = ["supports", "update_pallas", "pick_block_r"]
 
+# minimum row-block the grid requires (engine eligibility gate); the actual
+# block defaults to pick_block_r — at R=16,384 k=64 B=1024 on v5e, block 64
+# measured 3.18e9 elem/s and block 128 measured 3.85e9 (256 fails VMEM),
+# 2026-07-30
 _DEFAULT_BLOCK_R = 64
 _F32_MIN = float(jnp.finfo(jnp.float32).min)
+
+
+def pick_block_r(num_reservoirs: int, k: int, tile_b: int) -> int:
+    """VMEM-aware row-block (ops.blocking): ~4 k-wide planes (samples +
+    lkeys, in + out) and ~8 B-wide planes (elems, weights, cumsum, rank,
+    RNG words and masks), 4 bytes each."""
+    from .blocking import pick_block_r as _pick
+
+    return _pick(num_reservoirs, (4 * k + 8 * tile_b) * 4, _DEFAULT_BLOCK_R)
 
 
 def supports(
     state: WeightedState,
     valid,
     map_fn,
-    block_r: int = _DEFAULT_BLOCK_R,
+    block_r=None,
     batch: "jax.Array | None" = None,
 ) -> bool:
     """True iff this kernel can take the tile (else: XLA path)."""
+    need = _DEFAULT_BLOCK_R if block_r is None else block_r
     return (
         valid is None
         and map_fn is None
         and state.count.dtype == jnp.int32
         and state.samples.dtype in (jnp.int32, jnp.float32, jnp.uint32)
         and (batch is None or batch.dtype == state.samples.dtype)
-        and state.samples.shape[0] % block_r == 0
+        and state.samples.shape[0] % need == 0
     )
 
 
@@ -241,7 +255,7 @@ def update_pallas(
     elems: jax.Array,
     weights: jax.Array,
     *,
-    block_r: int = _DEFAULT_BLOCK_R,
+    block_r=None,
     interpret: bool = False,
 ) -> WeightedState:
     """Full-tile weighted update, bit-identical to
@@ -261,8 +275,11 @@ def update_pallas(
         raise ValueError(
             "update_pallas: unsupported config (need int32 counters, "
             f"int32/float32/uint32 samples, elems dtype == samples dtype, "
-            f"R % {block_r} == 0); use ops.weighted.update"
+            f"R % {block_r or _DEFAULT_BLOCK_R} == 0); "
+            "use ops.weighted.update"
         )
+    if block_r is None:
+        block_r = pick_block_r(R, k, B)
     kd1, kd2 = key_words(state.key)  # [R] uint32 each
     key_data = jnp.stack([kd1, kd2], axis=1)  # [R, 2]
 

@@ -29,7 +29,15 @@ Draw-order contract (shared by the per-element and bulk paths, so the
 ``sample == sample_all`` invariant 4 of SURVEY §2.2 holds by construction):
 
 1. at construction: ``u1, u2`` for the initial ``W``/``next``;
-2. at each acceptance: ``slot`` (integer in ``[0, k)``), then ``u1, u2``.
+2. at each acceptance: ``slot = floor(next_double * k)``, then ``u1, u2``.
+
+The slot draw is a scaled ``next_double`` rather than ``Generator.integers``
+so the native bulk scan (``_native/algl_scan.cc``) can replay the identical
+stream through the BitGenerator's ``next_double`` pointer alone; the
+truncation bias is ~2^-53 per draw, far below the 64-bit-hash bias class the
+distinct mode already documents.  Int64-array inputs to :meth:`sample_all`
+take that C scan when the native library is available (bit-identical
+results, ~30x the throughput); everything else runs the plain-Python loop.
 
 ``W`` is tracked in log-space so that ``n ~ 1e12``-scale streams do not
 underflow (SURVEY §7.3 "Float W in log-space").
@@ -75,6 +83,7 @@ class AlgorithmLOracle:
     ) -> None:
         self._k = validate_max_sample_size(int(k))
         self._rng = rng
+        self._identity_map = map_fn is None
         self._map = map_fn if map_fn is not None else lambda x: x
         # Growable buffer semantics (Sampler.scala:200-222).  A Python list
         # already grows geometrically, so `pre_allocate` is accepted for API
@@ -108,10 +117,13 @@ class AlgorithmLOracle:
         self._next += skip + 1
 
     def _evict(self, element: Any) -> None:
-        """Overwrite a uniformly random slot (``Sampler.scala:243-246``)."""
+        """Overwrite a uniformly random slot (``Sampler.scala:243-246``).
+
+        Scaled ``random()`` rather than ``integers()`` so the draw is one
+        ``next_double`` — replayable by the native scan (module docs)."""
         if self._aliased:
             self._ensure_unaliased()
-        slot = int(self._rng.integers(self._k))
+        slot = int(self._rng.random() * self._k)
         self._samples[slot] = self._map(element)
         self._advance()
 
@@ -169,6 +181,17 @@ class AlgorithmLOracle:
             self._count += 1
             self._append(seq[i])
             i += 1
+        # native fast path: the same skip-jump loop in C, drawing from the
+        # same numpy bit stream — bit-identical results (module docs)
+        if (
+            n - i > 512
+            and self._identity_map
+            and isinstance(seq, np.ndarray)
+            and seq.ndim == 1
+            and seq.dtype == np.int64
+            and self._try_native_scan(seq, i, n)
+        ):
+            return
         # skip-jump phase: land directly on acceptance indices.
         # seq[i] has absolute stream index count+1, so the next acceptance
         # (absolute index `next`) sits at offset i + (next - count) - 1.
@@ -180,6 +203,36 @@ class AlgorithmLOracle:
             self._count += target - i + 1
             i = target + 1
             self._evict(seq[target])
+
+    def _try_native_scan(self, seq: np.ndarray, i: int, n: int) -> bool:
+        """Run the C scan over ``seq[i:]``; False -> caller uses the Python
+        loop (native unavailable, or samples not int64-coercible)."""
+        from .. import native as _native
+
+        if self._aliased:
+            self._ensure_unaliased()
+        try:
+            # infer the dtype first: forcing int64 here would silently
+            # truncate float/bool/str samples held from earlier calls
+            samples = np.asarray(self._samples)
+        except (TypeError, ValueError, OverflowError):
+            return False
+        if samples.dtype != np.int64 or samples.shape != (self._k,):
+            return False
+        res = _native.algl_scan(
+            self._rng,
+            np.ascontiguousarray(seq[i:]),
+            self._k,
+            samples,
+            self._count,
+            self._next,
+            self._log_w,
+        )
+        if res is None:
+            return False
+        self._count, self._next, self._log_w = res
+        self._samples = list(samples)
+        return True
 
     def _sample_iterator(self, it: Iterator[Any]) -> None:
         while True:

@@ -168,6 +168,11 @@ class TestStatistics:
     def test_ks_distance_vs_oracle(self):
         # BASELINE gate: two-sample KS distance between device-sampled index
         # distribution and the CPU oracle's, < 1% (BASELINE.md north star).
+        # Pool sizing: with 131k oracle + 65k device samples the two-sample
+        # null 95th percentile is ~0.0065, a 1.5x margin under the literal
+        # 1% gate.  (The original 512-oracle pool had a null 95th pct of
+        # 0.0119 — ABOVE the gate — and failed on a pure draw-stream re-roll
+        # when the oracle's slot draw changed, 2026-07-30.)
         from reservoir_tpu.oracle import AlgorithmLOracle
 
         R, n, k = 2_048, 1_000, 32
@@ -177,7 +182,7 @@ class TestStatistics:
         dev = np.sort(np.asarray(samples).ravel())
 
         cpu = []
-        for seed in range(512):
+        for seed in range(4_096):
             o = AlgorithmLOracle(k, np.random.default_rng(seed))
             o.sample_all(range(n))
             cpu.extend(o.result())

@@ -74,8 +74,8 @@ def test_device_engine_auto_dispatches_pallas():
     for step in range(4):
         for e in engines.values():
             e.sample(mk(step * B))
-    assert any(key[3] for key in engines["auto"]._jit_cache)  # pallas used
-    assert not any(key[3] for key in engines["xla"]._jit_cache)
+    assert engines["auto"].pallas_used()
+    assert not engines["xla"].pallas_used()
     a, xs = engines["auto"].result_arrays(), engines["xla"].result_arrays()
     np.testing.assert_array_equal(a[0], xs[0])
     np.testing.assert_array_equal(a[1], xs[1])

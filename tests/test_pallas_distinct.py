@@ -91,3 +91,14 @@ def test_distinct_pallas_rejects_unsupported():
         dp.update_pallas(
             state, jnp.zeros((6, 8), jnp.int32), block_r=8, interpret=True
         )
+
+
+def test_pick_block_r():
+    from reservoir_tpu.ops.distinct_pallas import pick_block_r
+
+    assert pick_block_r(4096, 256, 1024) == 128  # the bench shape
+    assert pick_block_r(40, 256, 1024) == 8
+    # VMEM pressure: k-heavy states can't take 128 rows per cell, but the
+    # block never drops below the kernel's minimum (8)
+    assert 8 <= pick_block_r(4096, 8192, 1024) < 128
+    assert pick_block_r(4096, 1 << 22, 1024) == 8

@@ -89,3 +89,16 @@ def test_weighted_pallas_rejects_unsupported():
     weights = jnp.ones((6, 8), jnp.float32)
     with pytest.raises(ValueError, match="unsupported"):
         wp.update_pallas(state, elems, weights, block_r=8, interpret=True)
+
+
+def test_pick_block_r():
+    # adaptive row-block: largest power-of-2 divisor of R under the VMEM
+    # budget, capped at 128 (the measured v5e sweet spot; BENCH.md sweep
+    # 2026-07-30)
+    from reservoir_tpu.ops.weighted_pallas import pick_block_r
+
+    assert pick_block_r(16384, 64, 1024) == 128  # the bench shape
+    assert pick_block_r(64, 64, 1024) == 64
+    # VMEM pressure stops the widening, but never below the kernel's
+    # declared minimum grid block (the supports() gate)
+    assert pick_block_r(16384, 64, 65536) == 64
