@@ -166,6 +166,8 @@ class AlgorithmLOracle:
         (``sampleIterator``, ``:275-287``).  Produces results identical to a
         per-element loop under the same RNG state (invariant 4; tested).
         """
+        if isinstance(elements, range) and self._sample_range(elements):
+            return
         if isinstance(elements, (Sequence, np.ndarray)) and not isinstance(
             elements, (str, bytes)
         ):
@@ -173,13 +175,54 @@ class AlgorithmLOracle:
         else:
             self._sample_iterator(iter(elements))
 
-    def _sample_indexed(self, seq: Sequence[Any]) -> None:
+    # Materializing a range only beats the lazy skip-jump while the O(n)
+    # arange cost stays under the O(k log n) Python acceptance cost; past
+    # ~8M elements the lazy path is faster AND stays O(k) memory (a
+    # range(10**10) must never allocate 80 GB).
+    _RANGE_MATERIALIZE_CAP = 1 << 23
+
+    def _sample_range(self, r: range) -> bool:
+        """Materialize a modest range as int64 and ride the native scan —
+        BASELINE config 1 feeds exactly this shape.  Results stay plain
+        Python ints.  False -> caller runs the ordinary (lazy) path; every
+        precondition is checked *before* any state mutation so the
+        fallback replays from an untouched sampler."""
+        if not (512 < len(r) <= self._RANGE_MATERIALIZE_CAP):
+            return False
+        if not self._identity_map:
+            return False  # map_fn expects the range's plain ints
+        from .. import native as _native
+
+        if _native.load_library() is None:
+            # no C scan: the lazy range path is strictly better (and keeps
+            # storing plain ints, which the ndarray loop would not)
+            return False
+        if self._samples:
+            try:
+                resident = np.asarray(self._samples)
+            except (TypeError, ValueError, OverflowError):
+                return False
+            if resident.dtype != np.int64:
+                return False  # non-int resident samples: stay lazy
+        try:
+            arr = np.arange(r.start, r.stop, r.step, dtype=np.int64)
+        except (OverflowError, MemoryError):
+            return False  # out-of-int64 bounds or no memory: stay lazy
+        if arr.size != len(r):
+            return False
+        self._sample_indexed(arr, as_python_int=True)
+        return True
+
+    def _sample_indexed(
+        self, seq: Sequence[Any], as_python_int: bool = False
+    ) -> None:
         n = len(seq)
         i = 0
         # fill phase
         while self._count < self._k and i < n:
             self._count += 1
-            self._append(seq[i])
+            elem = seq[i]
+            self._append(int(elem) if as_python_int else elem)
             i += 1
         # native fast path: the same skip-jump loop in C, drawing from the
         # same numpy bit stream — bit-identical results (module docs)
@@ -189,7 +232,7 @@ class AlgorithmLOracle:
             and isinstance(seq, np.ndarray)
             and seq.ndim == 1
             and seq.dtype == np.int64
-            and self._try_native_scan(seq, i, n)
+            and self._try_native_scan(seq, i, n, as_python_int)
         ):
             return
         # skip-jump phase: land directly on acceptance indices.
@@ -202,9 +245,12 @@ class AlgorithmLOracle:
                 return
             self._count += target - i + 1
             i = target + 1
-            self._evict(seq[target])
+            elem = seq[target]
+            self._evict(int(elem) if as_python_int else elem)
 
-    def _try_native_scan(self, seq: np.ndarray, i: int, n: int) -> bool:
+    def _try_native_scan(
+        self, seq: np.ndarray, i: int, n: int, as_python_int: bool = False
+    ) -> bool:
         """Run the C scan over ``seq[i:]``; False -> caller uses the Python
         loop (native unavailable, or samples not int64-coercible)."""
         from .. import native as _native
@@ -231,7 +277,10 @@ class AlgorithmLOracle:
         if res is None:
             return False
         self._count, self._next, self._log_w = res
-        self._samples = list(samples)
+        # range inputs deliver plain ints (what the Python path stores)
+        self._samples = (
+            [int(v) for v in samples] if as_python_int else list(samples)
+        )
         return True
 
     def _sample_iterator(self, it: Iterator[Any]) -> None:

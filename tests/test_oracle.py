@@ -433,3 +433,43 @@ def test_algl_native_scan_preserves_non_int64_samples():
     finally:
         del os.environ["RESERVOIR_TPU_NO_NATIVE"]
     assert [float(x) for x in s.result()] == [float(x) for x in t.result()]
+
+
+def test_algl_range_fast_path_matches_array_and_python():
+    # range inputs materialize to int64 and ride the native scan; results
+    # must equal both the array feed and the no-native Python loop, and
+    # stay plain Python ints (what the Python range path stores)
+    import os
+
+    n, k = 200_000, 64
+    a = AlgorithmLOracle(k, np.random.default_rng(5))
+    a.sample_all(range(n))
+    b = AlgorithmLOracle(k, np.random.default_rng(5))
+    b.sample_all(np.arange(n, dtype=np.int64))
+    os.environ["RESERVOIR_TPU_NO_NATIVE"] = "1"
+    try:
+        c = AlgorithmLOracle(k, np.random.default_rng(5))
+        c.sample_all(range(n))
+    finally:
+        del os.environ["RESERVOIR_TPU_NO_NATIVE"]
+    assert (
+        [int(x) for x in a.result()]
+        == [int(x) for x in b.result()]
+        == [int(x) for x in c.result()]
+    )
+    # plain Python ints on EVERY route a range can take (native scan,
+    # no-native lazy fallback)
+    assert all(type(x) is int for x in a.result())
+    assert all(type(x) is int for x in c.result())
+    # stepped and negative ranges too
+    d = AlgorithmLOracle(k, np.random.default_rng(6))
+    d.sample_all(range(-n, n, 3))
+    e = AlgorithmLOracle(k, np.random.default_rng(6))
+    e.sample_all(np.arange(-n, n, 3, dtype=np.int64))
+    assert [int(x) for x in d.result()] == [int(x) for x in e.result()]
+    # a range past the materialization cap stays on the lazy path: fast,
+    # O(k) memory (a giant range must never allocate), plain ints
+    g = AlgorithmLOracle(k, np.random.default_rng(7))
+    g.sample_all(range(10**10))
+    assert g.count == 10**10
+    assert all(type(x) is int for x in g.result())

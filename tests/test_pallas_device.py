@@ -125,3 +125,34 @@ def test_device_distinct_pallas_matches_xla():
         np.testing.assert_array_equal(
             np.asarray(s_ref.size), np.asarray(s_pal.size)
         )
+
+
+def test_device_adaptive_blocks_match_xla():
+    """R=256 routes both kernels through the auto-picked 128-row blocks
+    (two grid cells) — the production block size of the bench shapes."""
+    from reservoir_tpu.ops import distinct as dd
+    from reservoir_tpu.ops import distinct_pallas as dp
+    from reservoir_tpu.ops import weighted as ww
+    from reservoir_tpu.ops import weighted_pallas as wp
+
+    R, k, B = 256, 64, 256
+    assert wp.pick_block_r(R, k, B) == 128
+    st = ww.init(jr.key(10), R, k)
+    elems = jax.lax.broadcasted_iota(jnp.int32, (R, B), 1)
+    weights = 0.5 + jr.uniform(jr.key(11), (R, B))
+    ref = ww.update(ww.update(st, elems, weights), elems + 7, weights)
+    got = wp.update_pallas(wp.update_pallas(st, elems, weights), elems + 7, weights)
+    np.testing.assert_array_equal(np.asarray(ref.samples), np.asarray(got.samples))
+    np.testing.assert_array_equal(np.asarray(ref.lkeys), np.asarray(got.lkeys))
+    np.testing.assert_array_equal(np.asarray(ref.xw), np.asarray(got.xw))
+
+    assert dp.pick_block_r(R, 128, 512) == 128
+    s_ref = s_pal = dd.init(jr.key(12), R, 128)
+    for step in range(2):
+        batch = jr.randint(jr.fold_in(jr.key(13), step), (R, 512), 0, 4000, jnp.int32)
+        s_ref = dd.update(s_ref, batch)
+        s_pal = dp.update_pallas(s_pal, batch)
+    np.testing.assert_array_equal(np.asarray(s_ref.values), np.asarray(s_pal.values))
+    np.testing.assert_array_equal(np.asarray(s_ref.hash_hi), np.asarray(s_pal.hash_hi))
+    np.testing.assert_array_equal(np.asarray(s_ref.hash_lo), np.asarray(s_pal.hash_lo))
+    np.testing.assert_array_equal(np.asarray(s_ref.size), np.asarray(s_pal.size))
