@@ -224,18 +224,23 @@ def _bench_bridge(S, k, B, steps, reps):
 
 
 def _bench_host(R, k, B, steps, reps):
-    """BASELINE config 1: the CPU host sampler over an in-memory int64
-    stream (``Sampler[Long,Long](k=128)`` over a 1M iterator) — the
-    skip-jump bulk path of the semantic oracle.  No device involved."""
+    """BASELINE config 1: the CPU host sampler over a 1M-element iterator
+    (``Sampler[Long,Long](k=128)``), fed as ``range(n)`` to match the
+    config's literal shape — the oracle materializes modest ranges and
+    rides the native C scan.  A pre-materialized int64 array measures
+    higher still (no arange inside the timed region); both are reported
+    in BENCH.md.  No device involved."""
     from reservoir_tpu.api import sampler
 
     n = R * B * steps
-    arr = np.arange(n, dtype=np.int64)
+    w = sampler(k, rng=999)
+    w.sample_all(range(n))  # warm: native-lib load, allocator, page cache
+    w.result()
     times = []
     for r in range(reps):
         s = sampler(k, rng=r)
         t0 = time.perf_counter()
-        s.sample_all(arr)
+        s.sample_all(range(n))
         s.result()
         times.append(time.perf_counter() - t0)
     return times
@@ -378,10 +383,27 @@ def main() -> None:
     steps = int(os.environ.get("RESERVOIR_BENCH_STEPS", default_steps))
     reps = int(os.environ.get("RESERVOIR_BENCH_REPS", 3))
 
+    tag_suffix = ""
     if config == "host":
         platform = "cpu-host"  # pure host path; never touch the backend
     else:
-        platform = _init_backend_with_retry()
+        try:
+            platform = _init_backend_with_retry()
+        except SystemExit as e:
+            # The device backend is unreachable after ~11 min of probing.
+            # A round must still record SOME honest number (VERDICT r1:
+            # one tunnel outage erased the round): fall back to the pure
+            # host-oracle config, with the fallback spelled out in the
+            # metric name so it can never be mistaken for a device row.
+            print(f"bench: {e}", file=sys.stderr)
+            print(
+                "bench: falling back to the host-oracle config "
+                "(no device backend)",
+                file=sys.stderr,
+            )
+            config, platform = "host", "cpu-host"
+            R, k, B, steps = 1, 128, 1_000_000, 1
+            tag_suffix = "_fallback_backend_unreachable"
     print(f"bench: backend ready ({platform})", file=sys.stderr)
 
     from reservoir_tpu.utils.tracing import maybe_profile
@@ -433,7 +455,7 @@ def main() -> None:
     print(
         json.dumps(
             {
-                "metric": f"{tag}_elements_per_sec_R{R}_k{k}_B{B}",
+                "metric": f"{tag}{tag_suffix}_elements_per_sec_R{R}_k{k}_B{B}",
                 "value": value,
                 "unit": "elem/s",
                 "vs_baseline": value / NORTH_STAR,

@@ -202,6 +202,15 @@ class ReservoirEngine:
             for key in self._jit_cache
         )
 
+    def xla_used(self) -> bool:
+        """True iff any update compiled so far took the XLA path (fill and
+        ragged tiles always do in duplicates mode) — :meth:`pallas_used`'s
+        counterpart, so callers never probe cache keys positionally."""
+        return any(
+            not (key[4] if key[0] == "stream_fused" else key[3])
+            for key in self._jit_cache
+        )
+
     @property
     def is_open(self) -> bool:
         """Reference ``isOpen`` (``Sampler.scala:67``): reusable engines are

@@ -181,13 +181,27 @@ class AlgorithmLOracle:
     # range(10**10) must never allocate 80 GB).
     _RANGE_MATERIALIZE_CAP = 1 << 23
 
+    def _coerce_samples_int64(self) -> Optional[np.ndarray]:
+        """The resident samples as an int64 array, or None when they are
+        not exactly int64-typed (floats/bools/strings must never be
+        coerced — the shared gate for both native-scan entry points)."""
+        try:
+            s = np.asarray(self._samples)
+        except (TypeError, ValueError, OverflowError):
+            return None
+        return s if s.dtype == np.int64 else None
+
     def _sample_range(self, r: range) -> bool:
         """Materialize a modest range as int64 and ride the native scan —
-        BASELINE config 1 feeds exactly this shape.  Results stay plain
-        Python ints.  False -> caller runs the ordinary (lazy) path; every
-        precondition is checked *before* any state mutation so the
+        the BASELINE config-1 "1M-element Iterator" shape.  Results stay
+        plain Python ints.  False -> caller runs the ordinary (lazy) path;
+        every precondition is checked *before* any state mutation so the
         fallback replays from an untouched sampler."""
-        if not (512 < len(r) <= self._RANGE_MATERIALIZE_CAP):
+        # gate on the POST-FILL remainder: elements the fill phase will
+        # consume cannot reach the C scan, and a mostly-fill range would
+        # materialize for nothing
+        remainder = len(r) - max(0, self._k - self._count)
+        if not (512 < remainder and len(r) <= self._RANGE_MATERIALIZE_CAP):
             return False
         if not self._identity_map:
             return False  # map_fn expects the range's plain ints
@@ -197,13 +211,11 @@ class AlgorithmLOracle:
             # no C scan: the lazy range path is strictly better (and keeps
             # storing plain ints, which the ndarray loop would not)
             return False
-        if self._samples:
-            try:
-                resident = np.asarray(self._samples)
-            except (TypeError, ValueError, OverflowError):
-                return False
-            if resident.dtype != np.int64:
-                return False  # non-int resident samples: stay lazy
+        # cheap pre-gate so a refusal never pays the arange; the scan
+        # itself re-derives the array post-fill (_try_native_scan), which
+        # is unavoidable — fill appends between these two points
+        if self._samples and self._coerce_samples_int64() is None:
+            return False
         try:
             arr = np.arange(r.start, r.stop, r.step, dtype=np.int64)
         except (OverflowError, MemoryError):
@@ -257,13 +269,10 @@ class AlgorithmLOracle:
 
         if self._aliased:
             self._ensure_unaliased()
-        try:
-            # infer the dtype first: forcing int64 here would silently
-            # truncate float/bool/str samples held from earlier calls
-            samples = np.asarray(self._samples)
-        except (TypeError, ValueError, OverflowError):
-            return False
-        if samples.dtype != np.int64 or samples.shape != (self._k,):
+        # int64-exact resident samples only: coercion would silently
+        # truncate float/bool/str samples held from earlier calls
+        samples = self._coerce_samples_int64()
+        if samples is None or samples.shape != (self._k,):
             return False
         res = _native.algl_scan(
             self._rng,
