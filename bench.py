@@ -362,25 +362,34 @@ def main() -> None:
         raise SystemExit(
             f"RESERVOIR_BENCH_IMPL must be auto|xla|pallas, got {impl!r}"
         )
-    defaults = {
-        "algl": (1024 if smoke else 65536, 128, 256 if smoke else 2048),
-        "distinct": (256 if smoke else 4096, 32 if smoke else 256, 1024),
-        "weighted": (512 if smoke else 16384, 64, 1024),
-        # bridge tiles are wide (B=4096): each flush pays fixed round-trip
-        # latency on tunneled backends, so per-flush volume is the lever
-        "bridge": (64 if smoke else 1024, 128, 128 if smoke else 4096),
-        "stream": (64 if smoke else 1024, 128, 128 if smoke else 2048),
-        "host": (1, 128, 50_000 if smoke else 1_000_000),  # BASELINE config 1
-    }[config]
-    R = int(os.environ.get("RESERVOIR_BENCH_R", defaults[0]))
-    k = int(os.environ.get("RESERVOIR_BENCH_K", defaults[1]))
-    B = int(os.environ.get("RESERVOIR_BENCH_B", defaults[2]))
-    default_steps = {
-        "bridge": 2 if smoke else 4,
-        "stream": 2 if smoke else 16,
-        "host": 1,
-    }.get(config, 5 if smoke else 50)
-    steps = int(os.environ.get("RESERVOIR_BENCH_STEPS", default_steps))
+    def _shape_for(cfg):
+        """(R, k, B, steps) for ``cfg`` — defaults modulated by smoke mode,
+        then env overrides.  One source of truth; the backend-unreachable
+        fallback re-derives the host shape through this same path."""
+        defaults = {
+            "algl": (1024 if smoke else 65536, 128, 256 if smoke else 2048),
+            "distinct": (256 if smoke else 4096, 32 if smoke else 256, 1024),
+            "weighted": (512 if smoke else 16384, 64, 1024),
+            # bridge tiles are wide (B=4096): each flush pays fixed round-
+            # trip latency on tunneled backends, so per-flush volume is
+            # the lever
+            "bridge": (64 if smoke else 1024, 128, 128 if smoke else 4096),
+            "stream": (64 if smoke else 1024, 128, 128 if smoke else 2048),
+            "host": (1, 128, 50_000 if smoke else 1_000_000),  # config 1
+        }[cfg]
+        default_steps = {
+            "bridge": 2 if smoke else 4,
+            "stream": 2 if smoke else 16,
+            "host": 1,
+        }.get(cfg, 5 if smoke else 50)
+        return (
+            int(os.environ.get("RESERVOIR_BENCH_R", defaults[0])),
+            int(os.environ.get("RESERVOIR_BENCH_K", defaults[1])),
+            int(os.environ.get("RESERVOIR_BENCH_B", defaults[2])),
+            int(os.environ.get("RESERVOIR_BENCH_STEPS", default_steps)),
+        )
+
+    R, k, B, steps = _shape_for(config)
     reps = int(os.environ.get("RESERVOIR_BENCH_REPS", 3))
 
     tag_suffix = ""
@@ -402,7 +411,7 @@ def main() -> None:
                 file=sys.stderr,
             )
             config, platform = "host", "cpu-host"
-            R, k, B, steps = 1, 128, 1_000_000, 1
+            R, k, B, steps = _shape_for("host")
             tag_suffix = "_fallback_backend_unreachable"
     print(f"bench: backend ready ({platform})", file=sys.stderr)
 

@@ -190,26 +190,23 @@ class ReservoirEngine:
     def config(self) -> SamplerConfig:
         return self._config
 
+    @staticmethod
+    def _key_uses_pallas(key) -> bool:
+        """THE owner of the jit-cache key layouts: per-tile keys are
+        ``(width, steady, ragged, use_pallas)``, fused-stream keys are
+        ``("stream_fused", n, B, steady, use_pallas, dtype)``."""
+        return key[4] if key[0] == "stream_fused" else key[3]
+
     def pallas_used(self) -> bool:
         """True iff any update compiled so far dispatched to a Pallas
-        kernel.  Owns the jit-cache key layouts (per-tile keys are
-        ``(width, steady, ragged, use_pallas)``, fused-stream keys are
-        ``("stream_fused", n, B, steady, use_pallas, dtype)``) so callers
-        (bench.py's impl-tag guard, dispatch tests) never probe them
-        positionally."""
-        return any(
-            (key[4] if key[0] == "stream_fused" else key[3])
-            for key in self._jit_cache
-        )
+        kernel — callers (bench.py's impl-tag guard, dispatch tests) use
+        this instead of probing cache keys positionally."""
+        return any(self._key_uses_pallas(k) for k in self._jit_cache)
 
     def xla_used(self) -> bool:
         """True iff any update compiled so far took the XLA path (fill and
-        ragged tiles always do in duplicates mode) — :meth:`pallas_used`'s
-        counterpart, so callers never probe cache keys positionally."""
-        return any(
-            not (key[4] if key[0] == "stream_fused" else key[3])
-            for key in self._jit_cache
-        )
+        ragged tiles always do in duplicates mode)."""
+        return any(not self._key_uses_pallas(k) for k in self._jit_cache)
 
     @property
     def is_open(self) -> bool:

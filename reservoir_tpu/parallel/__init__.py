@@ -6,6 +6,7 @@ the new first-class component: reservoir-axis data parallelism over a
 stream-axis parallelism via mergeable reservoir summaries.
 """
 
+from . import multihost
 from .sharded import (
     make_mesh,
     reservoir_sharding,
@@ -17,6 +18,7 @@ from .sharded import (
 
 __all__ = [
     "make_mesh",
+    "multihost",
     "reservoir_sharding",
     "shard_state",
     "sharded_update",

@@ -1,0 +1,85 @@
+"""Multi-host scale-out: joining the JAX process group.
+
+The reference has no distributed layer (SURVEY §2.4); this framework's
+communication backend is XLA collectives over whatever mesh
+:func:`~reservoir_tpu.parallel.make_mesh` builds.  Scaling from one host to
+a pod slice needs exactly one extra step — every process joins the JAX
+distributed runtime BEFORE first backend use.  After that ``jax.devices()``
+returns the *global* device list, ``make_mesh`` spans hosts, and the same
+``shard_map`` programs ride ICI within a host group and DCN across them
+(XLA chooses the transport; there is no NCCL/MPI analog to manage).
+
+Typical pod usage::
+
+    from reservoir_tpu.parallel import multihost
+    multihost.initialize()            # no-op single-process; auto-detects pods
+    mesh = make_mesh()                # now spans every host's chips
+    eng = ReservoirEngine(SamplerConfig(..., mesh_axis="res"), mesh=mesh)
+
+Result gathers (``sharded_result``) and stream-axis merges
+(:mod:`.merge`) are ordinary XLA collectives and work unchanged on a
+multi-host mesh.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+__all__ = ["initialize", "is_initialized"]
+
+
+def is_initialized() -> bool:
+    """Whether this process has joined a JAX distributed runtime."""
+    try:  # public location in newer jax; private module before that
+        import jax.distributed as jd
+
+        state = getattr(jd, "global_state", None)
+        if state is None:
+            from jax._src.distributed import global_state as state
+    except ImportError:  # pragma: no cover - layout changed again
+        return False
+    return getattr(state, "client", None) is not None
+
+
+def initialize(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+    **kwargs,
+) -> bool:
+    """Join the JAX process group; safe to call unconditionally.
+
+    - already joined -> True (idempotent, never re-initializes);
+    - explicit ``coordinator_address``/``num_processes``/``process_id``
+      -> joins (errors surface: the caller meant it);
+    - no arguments -> defers to JAX's own cluster auto-detection (GCE TPU
+      pod metadata, SLURM, Open MPI, ...); a plain single-process run has
+      nothing to detect and returns False without touching the backend
+      (``make_mesh`` then spans the local devices only).
+
+    Extra ``kwargs`` (e.g. ``local_device_ids``) pass through to
+    ``jax.distributed.initialize``.
+    """
+    import jax
+
+    if is_initialized():
+        return True
+    explicit = (
+        coordinator_address is not None
+        or num_processes is not None
+        or process_id is not None
+        or bool(kwargs)
+    )
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+            **kwargs,
+        )
+    except (RuntimeError, ValueError):
+        if explicit:
+            raise
+        # JAX found no cluster to auto-detect: ordinary single-process run
+        return False
+    return True

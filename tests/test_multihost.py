@@ -1,0 +1,65 @@
+"""Multi-host process-group join helper (parallel/multihost.py).
+
+The join mutates process-global JAX state, so the positive case runs in a
+subprocess; the in-process test only exercises the no-op path.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+from reservoir_tpu.parallel import multihost
+
+_DRIVE = """
+import os
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+import jax
+jax.config.update("jax_platforms", "cpu")
+from reservoir_tpu.parallel import multihost, make_mesh
+
+assert multihost.initialize("localhost:12357", num_processes=1, process_id=0)
+assert multihost.is_initialized()
+assert multihost.initialize() is True           # idempotent
+assert jax.process_count() == 1
+assert make_mesh().devices.size == 8            # spans the global devices
+print("OK")
+"""
+
+
+def test_initialize_noop_without_cluster():
+    # no coordinator and nothing for JAX to auto-detect on this box ->
+    # single-process no-op (False); if some earlier join happened in this
+    # process, idempotency returns True instead
+    if multihost.is_initialized():
+        assert multihost.initialize() is True
+    else:
+        assert multihost.initialize() is False
+        assert not multihost.is_initialized()
+
+
+def test_initialize_explicit_bad_args_raise():
+    if multihost.is_initialized():
+        return  # initialize() short-circuits before validating args
+    import pytest
+
+    with pytest.raises((RuntimeError, ValueError)):
+        # explicit intent with inconsistent args must surface, not be
+        # swallowed into the single-process False path
+        multihost.initialize(num_processes=2)
+
+
+def test_initialize_joins_single_process_group():
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env.pop("JAX_PLATFORMS", None)
+    proc = subprocess.run(
+        [sys.executable, "-c", _DRIVE],
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=300,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "OK" in proc.stdout
