@@ -3,8 +3,10 @@
 This is a from-scratch re-derivation of the *behavior* of the reference's
 ``RandomElements`` engine (``Sampler.scala:196-332``) — per-element Algorithm L
 with geometric skip counts — used as the statistical oracle for the device
-kernels and as the CPU baseline (BASELINE.md config 1).  It is intentionally
-plain Python/NumPy: clarity over speed.
+kernels and as the CPU baseline (BASELINE.md config 1).  The semantics live
+in plain Python (clarity over speed); int64-array and modest-range feeds
+additionally ride a bit-identical native C scan (see the draw-order notes
+below), so being the oracle costs nothing at benchmark scale.
 
 Algorithm L ("An optimal algorithm", Li 1994; referenced by the reference at
 ``Sampler.scala:227``):
