@@ -1,0 +1,81 @@
+"""Seeded shape-fuzz: Pallas kernels == XLA paths across random configs.
+
+The targeted suites pin known-tricky cases; this sweep varies (R, k, B,
+dtype, steps) together — deterministic seeds, interpret mode — to catch
+grid/block-edge interactions none of the hand-picked shapes cover (the
+auto block sizing makes the grid decomposition shape-dependent).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import jax.random as jr
+import numpy as np
+import pytest
+
+from reservoir_tpu.ops import algorithm_l as al
+from reservoir_tpu.ops import algorithm_l_pallas as alp
+from reservoir_tpu.ops import distinct as dd
+from reservoir_tpu.ops import distinct_pallas as dp
+from reservoir_tpu.ops import weighted as ww
+from reservoir_tpu.ops import weighted_pallas as wp
+
+_RNG = np.random.default_rng(20260730)
+_CASES = [
+    (
+        int(_RNG.choice([8, 16, 24, 40, 64, 72])),  # R (multiple of 8)
+        int(_RNG.integers(2, 40)),  # k
+        int(_RNG.choice([8, 32, 100, 256])),  # B
+        int(_RNG.integers(1, 4)),  # steps
+    )
+    for _ in range(6)
+]
+
+
+def _eq(a, b, fields):
+    for f in fields:
+        np.testing.assert_array_equal(
+            np.asarray(getattr(a, f)), np.asarray(getattr(b, f)), err_msg=f
+        )
+
+
+@pytest.mark.parametrize("R,k,B,steps", _CASES)
+def test_fuzz_weighted(R, k, B, steps):
+    s_ref = s_pal = ww.init(jr.key(R * 1000 + k), R, k)
+    for step in range(steps):
+        key = jr.fold_in(jr.key(7), step)
+        e = jr.randint(key, (R, B), 0, 1 << 30, jnp.int32)
+        w = jr.uniform(jr.fold_in(key, 1), (R, B)) * 3.0
+        w = w * (jr.uniform(jr.fold_in(key, 2), (R, B)) > 0.25)  # zeros
+        s_ref = ww.update(s_ref, e, w)
+        # block_r=8: the default gate wants R % 64, but any divisor block
+        # is legal — small blocks maximize grid-edge coverage here
+        s_pal = wp.update_pallas(s_pal, e, w, block_r=8, interpret=True)
+    _eq(s_ref, s_pal, ("samples", "lkeys", "count", "xw"))
+
+
+@pytest.mark.parametrize("R,k,B,steps", _CASES)
+def test_fuzz_distinct(R, k, B, steps):
+    s_ref = s_pal = dd.init(jr.key(R * 1000 + k + 1), R, k)
+    for step in range(steps):
+        key = jr.fold_in(jr.key(9), step)
+        b = jr.randint(key, (R, B), 0, max(4, R * B // 3), jnp.int32)
+        s_ref = dd.update(s_ref, b)
+        s_pal = dp.update_pallas(s_pal, b, interpret=True)
+    _eq(s_ref, s_pal, ("values", "hash_hi", "hash_lo", "size", "count"))
+
+
+@pytest.mark.parametrize("R,k,B,steps", _CASES)
+def test_fuzz_algl_steady(R, k, B, steps):
+    # the Algorithm-L kernel is steady-only: fill first via the XLA path
+    s = al.init(jr.key(R * 1000 + k + 2), R, k)
+    fill = jax.lax.broadcasted_iota(jnp.int32, (R, max(B, k)), 1)
+    s = al.update(s, fill)
+    s_ref = s_pal = s
+    for step in range(steps):
+        key = jr.fold_in(jr.key(11), step)
+        b = jr.randint(key, (R, B), 0, 1 << 30, jnp.int32)
+        s_ref = al.update_steady(s_ref, b)
+        s_pal = alp.update_steady_pallas(s_pal, b, block_r=8, interpret=True)
+    _eq(s_ref, s_pal, ("samples", "count", "nxt", "log_w"))
