@@ -1,0 +1,65 @@
+"""The driver contract of bench.py: EXACTLY one JSON line on stdout.
+
+Round 1 was scored from a bench run that died before printing — this test
+pins the output contract the driver parses (one line, required keys,
+sane values), on the CPU smoke shapes, in a clean subprocess.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run_bench(extra_env):
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    env.update(
+        RESERVOIR_BENCH_SMOKE="1",
+        RESERVOIR_BENCH_PLATFORM="cpu",
+        **extra_env,
+    )
+    proc = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "bench.py")],
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=420,
+        cwd=_REPO,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = [ln for ln in proc.stdout.splitlines() if ln.strip()]
+    assert len(lines) == 1, f"expected exactly one stdout line, got {lines}"
+    return json.loads(lines[0])
+
+
+@pytest.mark.parametrize("config", ["algl", "host"])
+def test_bench_prints_one_parseable_json_line(config):
+    rec = _run_bench({"RESERVOIR_BENCH_CONFIG": config})
+    assert set(rec) >= {"metric", "value", "unit", "vs_baseline", "median", "reps"}
+    assert rec["unit"] == "elem/s"
+    assert rec["value"] > 0 and rec["median"] > 0
+    assert rec["reps"] == 3
+    assert abs(rec["vs_baseline"] - rec["value"] / 1e9) < 1e-9
+    assert config in rec["metric"] or config == "algl"
+
+
+def test_bench_rejects_unknown_config():
+    env = dict(os.environ)
+    env.update(RESERVOIR_BENCH_SMOKE="1", RESERVOIR_BENCH_CONFIG="nope")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "bench.py")],
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=120,
+        cwd=_REPO,
+    )
+    assert proc.returncode != 0
+    assert "RESERVOIR_BENCH_CONFIG" in proc.stderr
