@@ -19,7 +19,10 @@ RPC latency is tens of ms and ``block_until_ready`` can return early):
 
 Prints exactly ONE JSON line:
   {"metric": ..., "value": N, "unit": "elem/s", "vs_baseline": N,
-   "median": N, "reps": N}
+   "median": N, "reps": N, "platform": "tpu"|"cpu"|"cpu-host",
+   # TPU only — on-chip pallas==xla bit-equality evidence (VERDICT r2):
+   "pallas_parity": bool, "selftest": {"algl": ..., "distinct": ...,
+   "weighted": ..., ...}}
 
 Env knobs:
   RESERVOIR_BENCH_SMOKE=1       tiny shapes for a CPU smoke run
@@ -66,28 +69,19 @@ import numpy as np
 NORTH_STAR = 1e9  # elem/s (BASELINE.md)
 
 
-def _probe_backend(timeout_s: float) -> bool:
-    """Probe backend liveness in a THROWAWAY subprocess with a hard timeout.
+def _probe_backend_proc(timeout_s: float):
+    """Hang-proof subprocess liveness probe; platform string or None.
 
-    The tunnel fails two ways: a fast ``RuntimeError: ... UNAVAILABLE`` and a
-    silent hang inside ``jax.devices()`` (observed 2026-07-29 — a hang in the
-    main process is unrecoverable and would eat the driver's whole timeout).
-    Probing in a subprocess makes both failure modes cheap and retryable."""
-    code = (
-        "import jax, sys; d = jax.devices(); "
-        "x = jax.numpy.zeros((8,)); float(x.sum()); "
-        "sys.stdout.write(d[0].platform)"
-    )
-    try:
-        proc = subprocess.run(
-            [sys.executable, "-c", code],
-            capture_output=True,
-            timeout=timeout_s,
-            text=True,
-        )
-        return proc.returncode == 0
-    except subprocess.TimeoutExpired:
-        return False
+    The probe contract itself lives in ``reservoir_tpu.utils.probe`` (one
+    copy — this module, ``tools/tpu_watch.py`` and the selftest all share
+    it)."""
+    from reservoir_tpu.utils.probe import probe_backend_proc
+
+    return probe_backend_proc(timeout_s)
+
+
+def _probe_backend(timeout_s: float) -> bool:
+    return _probe_backend_proc(timeout_s) is not None
 
 
 def _init_backend_with_retry(
@@ -362,10 +356,13 @@ def main() -> None:
         raise SystemExit(
             f"RESERVOIR_BENCH_IMPL must be auto|xla|pallas, got {impl!r}"
         )
-    def _shape_for(cfg):
+    def _shape_for(cfg, use_env=True):
         """(R, k, B, steps) for ``cfg`` — defaults modulated by smoke mode,
-        then env overrides.  One source of truth; the backend-unreachable
-        fallback re-derives the host shape through this same path."""
+        then env overrides.  The backend-unreachable fallback passes
+        ``use_env=False``: R/K/B/STEPS overrides were addressed to the
+        original *device* config and must not reshape the host fallback
+        (ADVICE r2 — e.g. algl-scale R=65536 would turn the 1M-element
+        host row into a 6.7e9-element run)."""
         defaults = {
             "algl": (1024 if smoke else 65536, 128, 256 if smoke else 2048),
             "distinct": (256 if smoke else 4096, 32 if smoke else 256, 1024),
@@ -382,6 +379,8 @@ def main() -> None:
             "stream": 2 if smoke else 16,
             "host": 1,
         }.get(cfg, 5 if smoke else 50)
+        if not use_env:
+            return (defaults[0], defaults[1], defaults[2], default_steps)
         return (
             int(os.environ.get("RESERVOIR_BENCH_R", defaults[0])),
             int(os.environ.get("RESERVOIR_BENCH_K", defaults[1])),
@@ -411,7 +410,7 @@ def main() -> None:
                 file=sys.stderr,
             )
             config, platform = "host", "cpu-host"
-            R, k, B, steps = _shape_for("host")
+            R, k, B, steps = _shape_for("host", use_env=False)
             tag_suffix = "_fallback_backend_unreachable"
     print(f"bench: backend ready ({platform})", file=sys.stderr)
 
@@ -461,18 +460,38 @@ def main() -> None:
     n_elems = R * B * steps
     value = n_elems / min(times)
     median = n_elems / sorted(times)[len(times) // 2]
-    print(
-        json.dumps(
-            {
-                "metric": f"{tag}{tag_suffix}_elements_per_sec_R{R}_k{k}_B{B}",
-                "value": value,
-                "unit": "elem/s",
-                "vs_baseline": value / NORTH_STAR,
-                "median": median,
-                "reps": reps,
-            }
-        )
-    )
+    record = {
+        "metric": f"{tag}{tag_suffix}_elements_per_sec_R{R}_k{k}_B{B}",
+        "value": value,
+        "unit": "elem/s",
+        "vs_baseline": value / NORTH_STAR,
+        "median": median,
+        "reps": reps,
+        "platform": platform,
+    }
+    if (
+        platform == "tpu"
+        and os.environ.get("RESERVOIR_BENCH_SELFTEST", "1") == "1"
+    ):
+        # Embed on-chip pallas==xla bit-equality into the artifact itself
+        # (VERDICT r2 item 2): the device-gated parity suite never reaches
+        # driver artifacts, so the bench line carries the proof.  Runs in a
+        # subprocess with a hard timeout — a tunnel drop or Mosaic hang
+        # during the selftest must cost minutes, not erase the number that
+        # was just measured.
+        from reservoir_tpu.utils.selftest import device_selftest_subprocess
+
+        try:
+            # release the TPU client first: standard libtpu allows ONE
+            # process on the chip, and the selftest child must init its own
+            # backend (timed work is done — nothing left to lose here)
+            jax.extend.backend.clear_backends()
+        except Exception as e:
+            print(f"bench: clear_backends before selftest: {e}", file=sys.stderr)
+        st = device_selftest_subprocess(timeout_s=900.0)
+        record["pallas_parity"] = st.pop("pallas_parity", False)
+        record["selftest"] = st
+    print(json.dumps(record))
 
 
 if __name__ == "__main__":

@@ -243,7 +243,10 @@ class AlgorithmLOracle:
         if (
             n - i > 512
             and self._identity_map
-            and isinstance(seq, np.ndarray)
+            # exact-type gate: ndarray *subclasses* (np.ma.MaskedArray,
+            # np.matrix) override __getitem__ semantics the raw-buffer C
+            # scan would ignore — they keep the Python path (ADVICE r2)
+            and type(seq) is np.ndarray
             and seq.ndim == 1
             and seq.dtype == np.int64
             and self._try_native_scan(seq, i, n, as_python_int)

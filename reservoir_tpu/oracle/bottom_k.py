@@ -106,7 +106,8 @@ class BottomKOracle:
         candidates — identical results to per-element calls by construction
         (same hashes, same arrival order)."""
         if (
-            isinstance(elements, np.ndarray)
+            # exact type: ndarray subclasses (MaskedArray) keep the loop
+            type(elements) is np.ndarray
             and elements.ndim == 1
             and elements.dtype.kind in "iu"
             and elements.dtype.itemsize <= 8

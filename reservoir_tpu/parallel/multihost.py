@@ -28,6 +28,40 @@ from typing import Optional
 __all__ = ["initialize", "is_initialized"]
 
 
+def _cluster_env_detected() -> bool:
+    """Whether JAX's cluster auto-detection would find an environment.
+
+    Uses the same registry ``jax.distributed.initialize`` consults
+    (``ClusterEnv`` subclasses: GCE TPU pod metadata, SLURM, Open MPI, ...)
+    so :func:`initialize` can tell "nothing to join" apart from "cluster
+    present but the join failed".  Falls back to well-known env markers if
+    the private registry moves.
+    """
+    try:
+        from jax._src.clusters import ClusterEnv
+
+        # mirror jax's auto_detect filter: opt-in-only detectors (e.g.
+        # Mpi4pyCluster, whose is_env_present is just "mpi4py importable")
+        # are NOT consulted by a no-arg initialize, so their presence must
+        # not promote a plain single-process run into a re-raise
+        return any(
+            c.is_env_present()
+            for c in ClusterEnv._cluster_types
+            if not getattr(c, "opt_in_only_method", False)
+        )
+    except Exception:  # pragma: no cover - jax internal layout changed
+        import os
+
+        markers = (
+            "SLURM_JOB_ID",
+            "OMPI_COMM_WORLD_SIZE",
+            "TPU_WORKER_HOSTNAMES",
+            "CLOUD_TPU_TASK_ID",
+            "MEGASCALE_COORDINATOR_ADDRESS",
+        )
+        return any(m in os.environ for m in markers)
+
+
 def is_initialized() -> bool:
     """Whether this process has joined a JAX distributed runtime."""
     try:  # public location in newer jax; private module before that
@@ -77,9 +111,23 @@ def initialize(
             process_id=process_id,
             **kwargs,
         )
-    except (RuntimeError, ValueError):
-        if explicit:
+    except (RuntimeError, ValueError) as e:
+        if explicit or _cluster_env_detected():
+            # The caller meant to join (explicit params), or a cluster
+            # environment IS present and the join still failed (e.g.
+            # coordinator unreachable on a real pod) — silently degrading
+            # to single-process would hand back per-host-only results.
             raise
-        # JAX found no cluster to auto-detect: ordinary single-process run
+        # JAX found no cluster to auto-detect: ordinary single-process run.
+        # Still surface the swallowed error — "no cluster" is an inference,
+        # not a certainty (ADVICE r2).
+        import warnings
+
+        warnings.warn(
+            "multihost.initialize(): no cluster environment detected; "
+            f"running single-process (jax.distributed.initialize said: {e})",
+            RuntimeWarning,
+            stacklevel=2,
+        )
         return False
     return True

@@ -1,0 +1,43 @@
+"""THE one copy of the backend-liveness probe contract.
+
+The axon TPU tunnel fails two ways: a fast ``RuntimeError: ...
+UNAVAILABLE`` and a silent hang inside ``jax.devices()`` (observed
+2026-07-29; outages last 10+ hours).  A hang in the caller's process is
+unrecoverable, so liveness is always checked in a THROWAWAY subprocess
+with a hard timeout.  ``bench.py``, ``tools/tpu_watch.py`` and
+:mod:`.selftest` all import this module — a tweak for the tunnel's next
+failure mode lands in exactly one place.
+"""
+
+from __future__ import annotations
+
+import subprocess
+import sys
+
+__all__ = ["PROBE_SNIPPET", "probe_backend_proc"]
+
+PROBE_SNIPPET = (
+    "import jax, sys; d = jax.devices(); "
+    "x = jax.numpy.zeros((8,)); float(x.sum()); "
+    "sys.stdout.write(d[0].platform)"
+)
+
+
+def probe_backend_proc(timeout_s: float):
+    """Probe the default backend in a throwaway subprocess.
+
+    Returns the platform string (e.g. ``"tpu"``) on success, None on
+    failure or hang.
+    """
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", PROBE_SNIPPET],
+            capture_output=True,
+            timeout=timeout_s,
+            text=True,
+        )
+    except subprocess.TimeoutExpired:
+        return None
+    if proc.returncode != 0:
+        return None
+    return proc.stdout.strip() or None
