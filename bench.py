@@ -27,15 +27,21 @@ Prints exactly ONE JSON line:
 Env knobs:
   RESERVOIR_BENCH_SMOKE=1       tiny shapes for a CPU smoke run
   RESERVOIR_BENCH_CONFIG        algl (default) | distinct | weighted |
-                                bridge | stream | host
+                                bridge | stream | host | transfer
                                 (bridge = incremental host-feed: interleaved
-                                demux -> staging -> per-flush dispatches;
-                                stream = fused host-feed: one scanned
-                                dispatch over a host [R, N] array — the two
-                                ends of SURVEY §7.3's host-path spectrum;
-                                host = the CPU oracle over a 1M in-memory
-                                stream, BASELINE config 1 — never touches
-                                the device backend)
+                                demux -> staging -> per-flush dispatches,
+                                double-buffered; stream = fused host-feed:
+                                one scanned dispatch over a host [R, N]
+                                array — the two ends of SURVEY §7.3's
+                                host-path spectrum; host = the CPU oracle
+                                over a 1M in-memory stream, BASELINE
+                                config 1 — never touches the device
+                                backend; transfer = RAW device_put
+                                bandwidth at the bridge tile shape, the
+                                wire ceiling for the bridge row)
+  RESERVOIR_BENCH_BLOCK_R       algl Pallas row-block (default 64; 0 = auto)
+  RESERVOIR_BENCH_BRIDGE_PIPELINED  1 (default) double-buffered bridge;
+                                0 = serial single-tile path
   RESERVOIR_BENCH_IMPL          auto (default) | xla | pallas   (all three
                                 modes; auto tries the Pallas kernel on TPU
                                 and falls back to the XLA path if Mosaic
@@ -160,9 +166,14 @@ def _bench_algl(R, k, B, steps, reps, impl):
     if impl == "pallas":
         from reservoir_tpu.ops import algorithm_l_pallas as alp
 
+        # block 64 is the known-good Mosaic compile; the restructured
+        # kernel's larger blocks (auto = pick_block_r, up to 128) are
+        # flipped in via env once a TPU window has timed their compile
+        # (RESERVOIR_BENCH_BLOCK_R=0 -> auto)
+        block_env = int(os.environ.get("RESERVOIR_BENCH_BLOCK_R", 64))
         step_fn = functools.partial(
             alp.update_steady_pallas,
-            block_r=64,
+            block_r=None if block_env == 0 else block_env,
             # Mosaic compiles on TPU; the CPU backend only has the interpreter
             interpret=jax.default_backend() == "cpu",
         )
@@ -192,12 +203,15 @@ def _bench_bridge(S, k, B, steps, reps):
     """Host-feed path: interleaved (stream, element) demux -> staging tile ->
     ragged device flushes (BASELINE config 5's single-chip shape).  Measures
     end-to-end host wall time including the Python/C++ demux — the component
-    SURVEY §7.3 flags as the real 1e9-elem/s bottleneck."""
+    SURVEY §7.3 flags as the real 1e9-elem/s bottleneck.  Double-buffered
+    by default (demux overlaps transfer+dispatch);
+    RESERVOIR_BENCH_BRIDGE_PIPELINED=0 times the serial path."""
     from reservoir_tpu import SamplerConfig
     from reservoir_tpu.stream.bridge import DeviceStreamBridge
 
+    pipelined = os.environ.get("RESERVOIR_BENCH_BRIDGE_PIPELINED", "1") == "1"
     cfg = SamplerConfig(max_sample_size=k, num_reservoirs=S, tile_size=B)
-    bridge = DeviceStreamBridge(cfg, key=0, reusable=True)
+    bridge = DeviceStreamBridge(cfg, key=0, reusable=True, pipelined=pipelined)
     n = S * B * steps
     rng = np.random.default_rng(0)
     streams = rng.integers(0, S, n).astype(np.int32)
@@ -206,9 +220,42 @@ def _bench_bridge(S, k, B, steps, reps):
     def one_pass():
         bridge.push_interleaved(streams, elems)
         bridge.flush()
+        bridge.drain_barrier()  # all flushes dispatched before readback
         _readback_barrier(bridge._engine._state.count)
 
     one_pass()  # warm: compiles every flush shape
+    times = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        one_pass()
+        times.append(time.perf_counter() - t0)
+    return times
+
+
+def _bench_transfer(S, k, B, steps, reps):
+    """RAW host->device transfer bandwidth at the bridge's tile shape — the
+    wire ceiling the bridge number is judged against (VERDICT r2 item 3:
+    'on PCIe the ceiling is the wire' must be an extrapolation from data,
+    not a claim).  No sampling: device_put + a one-element readback per
+    tile, disjoint source tiles so nothing is cached."""
+    import jax
+
+    rng = np.random.default_rng(1)
+    tiles = [
+        rng.integers(0, 1 << 31, (S, B), dtype=np.int64).astype(np.int32)
+        for _ in range(steps)
+    ]
+    dev = jax.devices()[0]
+
+    def one_pass():
+        for t in tiles:
+            x = jax.device_put(t, dev)
+            # honest completion: a host readback per tile —
+            # block_until_ready can return early on RPC backends (see the
+            # module docstring's timing protocol)
+            _readback_barrier(x)
+
+    one_pass()  # warm: allocator, layouts
     times = []
     for _ in range(reps):
         t0 = time.perf_counter()
@@ -346,11 +393,12 @@ def main() -> None:
     config = os.environ.get("RESERVOIR_BENCH_CONFIG", "algl")
     impl = os.environ.get("RESERVOIR_BENCH_IMPL", "auto")
     if config not in (
-        "algl", "distinct", "weighted", "bridge", "stream", "host"
+        "algl", "distinct", "weighted", "bridge", "stream", "host",
+        "transfer",
     ):
         raise SystemExit(
             "RESERVOIR_BENCH_CONFIG must be algl|distinct|weighted|bridge|"
-            f"stream|host, got {config!r}"
+            f"stream|host|transfer, got {config!r}"
         )
     if impl not in ("auto", "xla", "pallas"):
         raise SystemExit(
@@ -373,11 +421,15 @@ def main() -> None:
             "bridge": (64 if smoke else 1024, 128, 128 if smoke else 4096),
             "stream": (64 if smoke else 1024, 128, 128 if smoke else 2048),
             "host": (1, 128, 50_000 if smoke else 1_000_000),  # config 1
+            # transfer mirrors the bridge tile shape: its number is the
+            # wire ceiling the bridge row is compared against
+            "transfer": (64 if smoke else 1024, 128, 128 if smoke else 4096),
         }[cfg]
         default_steps = {
             "bridge": 2 if smoke else 4,
             "stream": 2 if smoke else 16,
             "host": 1,
+            "transfer": 2 if smoke else 4,
         }.get(cfg, 5 if smoke else 50)
         if not use_env:
             return (defaults[0], defaults[1], defaults[2], default_steps)
@@ -454,6 +506,9 @@ def main() -> None:
         elif config == "host":
             times = _bench_host(R, k, B, steps, reps)
             tag = "host_oracle"
+        elif config == "transfer":
+            times = _bench_transfer(R, k, B, steps, reps)
+            tag = "raw_transfer"
         else:
             times = _bench_bridge(R, k, B, steps, reps)
             tag = "bridge_host_feed"

@@ -1,0 +1,156 @@
+/* TpuSample: drop-in replacement for the reference's `Sample` stage that
+ * keeps its sampling state in a reservoir_tpu SampleServer (see
+ * reservoir_tpu/stream/interop.py for the wire protocol).
+ *
+ * Existing Akka flows run unchanged except for the constructor:
+ *
+ *   // reference:              Sample[Long, Long](k)(identity)
+ *   // this shim:              TpuSample(k, "127.0.0.1", port)
+ *   val graph = Source(1L to 1000000L)
+ *     .viaMat(TpuSample(k, host, port))(Keep.right)
+ *     .toMat(Sink.ignore)(Keep.left)
+ *
+ * Stream semantics are identical to the reference stage: pass-through
+ * emit on push, pull-based backpressure (plus TCP flow control when the
+ * server lags), and the full completion protocol — upstream finish
+ * delivers the sample, upstream failure fails the future, graceful
+ * downstream cancel delivers the partial sample, cancel-with-cause and
+ * abrupt stop fail it.
+ *
+ * NOTE: this example ships as source; the build image for the Python
+ * framework has no JVM, so it is compiled/tested against a real Akka
+ * setup, not in this repo's CI.  sbt deps: akka-stream 2.6.x.
+ */
+package reservoir.tpu.interop
+
+import akka.stream._
+import akka.stream.stage._
+import scala.concurrent.{Future, Promise}
+import java.io.{DataInputStream, DataOutputStream, BufferedOutputStream}
+import java.net.Socket
+
+object TpuSample {
+  /** Uniform (duplicates-allowed) sampling flow; materializes the future
+    * sample of Longs. */
+  def apply(
+      maxSampleSize: Int,
+      host: String,
+      port: Int,
+      batchSize: Int = 4096
+  ): akka.stream.scaladsl.Flow[Long, Long, Future[IndexedSeq[Long]]] =
+    akka.stream.scaladsl.Flow.fromGraph(
+      new TpuSampleStage(maxSampleSize, host, port, distinct = false, batchSize)
+    )
+
+  /** Distinct-value sampling flow (the reference's `Sample.distinct`). */
+  def distinct(
+      maxSampleSize: Int,
+      host: String,
+      port: Int,
+      batchSize: Int = 4096
+  ): akka.stream.scaladsl.Flow[Long, Long, Future[IndexedSeq[Long]]] =
+    akka.stream.scaladsl.Flow.fromGraph(
+      new TpuSampleStage(maxSampleSize, host, port, distinct = true, batchSize)
+    )
+}
+
+final class TpuSampleStage(
+    maxSampleSize: Int,
+    host: String,
+    port: Int,
+    distinct: Boolean,
+    batchSize: Int
+) extends GraphStageWithMaterializedValue[FlowShape[Long, Long], Future[
+      IndexedSeq[Long]
+    ]] {
+  require(
+    maxSampleSize > 0 && maxSampleSize <= Int.MaxValue - 2,
+    "invalid maxSampleSize" // eager validation, as in the reference factory
+  )
+
+  private val in = Inlet[Long]("TpuSample.in")
+  private val out = Outlet[Long]("TpuSample.out")
+  override val shape: FlowShape[Long, Long] = FlowShape(in, out)
+
+  override def createLogicAndMaterializedValue(
+      attrs: Attributes
+  ): (GraphStageLogic, Future[IndexedSeq[Long]]) = {
+    val promise = Promise[IndexedSeq[Long]]()
+
+    val logic = new GraphStageLogic(shape) with InHandler with OutHandler {
+      private var socket: Socket = _
+      private var outS: DataOutputStream = _
+      private var inS: DataInputStream = _
+      private val buf = new Array[Long](batchSize)
+      private var n = 0
+
+      override def preStart(): Unit = {
+        // one connection per materialization == one fresh server-side
+        // sampler (the by-name thunk semantics of the reference factory)
+        socket = new Socket(host, port)
+        outS = new DataOutputStream(
+          new BufferedOutputStream(socket.getOutputStream)
+        )
+        inS = new DataInputStream(socket.getInputStream)
+        outS.write("RSV1".getBytes("US-ASCII"))
+        outS.writeByte(if (distinct) 1 else 0)
+        outS.writeInt(maxSampleSize)
+      }
+
+      private def flushBatch(): Unit = if (n > 0) {
+        outS.writeByte('B'); outS.writeInt(n)
+        var i = 0
+        while (i < n) { outS.writeLong(buf(i)); i += 1 }
+        n = 0
+      }
+
+      private def complete(): Unit = {
+        flushBatch(); outS.writeByte('C'); outS.flush()
+        if (inS.readByte() != 'R')
+          throw new IllegalStateException("bad result frame")
+        val size = inS.readInt()
+        val res = Vector.newBuilder[Long]
+        var i = 0
+        while (i < size) { res += inS.readLong(); i += 1 }
+        promise.trySuccess(res.result())
+        socket.close()
+      }
+
+      private def abort(): Unit = {
+        try { outS.writeByte('F'); outS.flush(); inS.readByte() }
+        finally socket.close()
+      }
+
+      // hot path: re-emit and buffer; a full buffer writes one frame
+      // (socket-buffered — TCP flow control is the backpressure coupling)
+      override def onPush(): Unit = {
+        val e = grab(in)
+        buf(n) = e; n += 1
+        if (n == batchSize) flushBatch()
+        push(out, e)
+      }
+      override def onPull(): Unit = pull(in)
+
+      override def onUpstreamFinish(): Unit = { complete(); completeStage() }
+      override def onUpstreamFailure(ex: Throwable): Unit = {
+        promise.tryFailure(ex); abort(); failStage(ex)
+      }
+      override def onDownstreamFinish(cause: Throwable): Unit = cause match {
+        case _: SubscriptionWithCancelException.NonFailureCancellation =>
+          complete(); cancelStage(cause)
+        case ex =>
+          promise.tryFailure(ex); abort(); cancelStage(cause)
+      }
+      override def postStop(): Unit =
+        if (
+          promise.tryFailure(
+            new AbruptStageTerminationException(this)
+          )
+        ) { try socket.close() catch { case _: Throwable => () } }
+
+      setHandlers(in, out, this)
+    }
+
+    (logic, promise.future)
+  }
+}

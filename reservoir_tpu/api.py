@@ -239,8 +239,11 @@ def distinct(
 
     Each *distinct value* of the stream has uniform inclusion probability.
     ``map_fn`` is applied to every element (it feeds the hash,
-    ``Sampler.scala:155``); ``hash_fn`` defaults to a stable 64-bit identity/
-    FNV hash (``Sampler.scala:75`` analog).
+    ``Sampler.scala:155``); ``hash_fn`` defaults to a stable 64-bit hash
+    covering every stable hashable — ints (identity embedding), floats,
+    str/bytes, None, tuples, frozensets (canonical-serialization FNV;
+    ``Sampler.scala:75`` analog).  Only objects with process-salted or
+    id-based hashes need an explicit ``hash_fn``.
     """
     # keep the user's map_fn as given (None = identity): the oracle's
     # vectorized bulk path only engages without a per-element map hook

@@ -93,7 +93,10 @@ class SamplerConfig:
         ``element_dtype``.
       count_dtype: dtype of the per-reservoir element counter.  ``int32``
         supports 2^31-1 elements *per reservoir* (ample for sharded streams);
-        pass ``int64`` with x64 enabled for longer single streams.
+        ``"wide"`` carries counters as emulated-uint64 uint32 planes —
+        streams past 2^31 per reservoir with x64 OFF (duplicates mode only;
+        the reference's ``count: Long``, ``Sampler.scala:203``); ``int64``
+        with x64 enabled also works.
       distinct: bottom-k distinct-value mode (``Sampler.scala:383-412``).
       weighted: A-ExpJ weighted mode (capability beyond the reference).
       mesh_axis: mesh axis name the reservoir dimension is sharded over
@@ -131,6 +134,11 @@ class SamplerConfig:
         if self.impl not in ("auto", "xla", "pallas"):
             raise ValueError(
                 f"impl must be 'auto', 'xla' or 'pallas', got {self.impl!r}"
+            )
+        if self.count_dtype == "wide" and (self.distinct or self.weighted):
+            raise ValueError(
+                "count_dtype='wide' is only supported in duplicates mode "
+                "(distinct/weighted counters stay int32)"
             )
 
     @property

@@ -102,17 +102,27 @@ class ReservoirEngine:
             # weighted and distinct kernels take every full tile.
             if map_fn is not None:
                 raise ValueError("impl='pallas' requires an identity map_fn")
+            if config.count_dtype == "wide":
+                raise ValueError(
+                    "impl='pallas' requires int32 counters (the kernel's "
+                    "supports() contract); count_dtype='wide' dispatches "
+                    "XLA — use impl='auto'"
+                )
             if hash_fn is not None:
                 raise ValueError(
                     "impl='pallas' requires the default hash (the kernel "
                     "owns the value-bits embedding); use impl='auto'"
                 )
-            block_r = self._pallas_module()._DEFAULT_BLOCK_R
-            if config.num_reservoirs % block_r != 0:
-                raise ValueError(
-                    "impl='pallas' requires num_reservoirs divisible by "
-                    f"{block_r}, got {config.num_reservoirs}"
-                )
+            if config.distinct or config.weighted:
+                # the Algorithm-L kernel pads partial row-blocks with inert
+                # lanes (any R); the distinct/weighted kernels still require
+                # block divisibility
+                block_r = self._pallas_module()._DEFAULT_BLOCK_R
+                if config.num_reservoirs % block_r != 0:
+                    raise ValueError(
+                        "impl='pallas' requires num_reservoirs divisible by "
+                        f"{block_r}, got {config.num_reservoirs}"
+                    )
             # mesh_axis is fine: the kernel is collective-free over the
             # reservoir grid, so it runs under shard_map with each chip
             # taking its row-blocks; per-shard divisibility is checked after
@@ -137,7 +147,7 @@ class ReservoirEngine:
                     f"evenly over the {n_shards}-device '{config.mesh_axis}' "
                     "mesh axis"
                 )
-            if config.impl == "pallas":
+            if config.impl == "pallas" and (config.distinct or config.weighted):
                 block_r = self._pallas_module()._DEFAULT_BLOCK_R
                 if (config.num_reservoirs // n_shards) % block_r != 0:
                     raise ValueError(
@@ -167,7 +177,13 @@ class ReservoirEngine:
                 config.num_reservoirs,
                 config.max_sample_size,
                 sample_dtype=jnp.dtype(config.resolved_sample_dtype()),
-                count_dtype=jnp.dtype(config.count_dtype),
+                # "wide" rides through as the emulated-uint64 sentinel
+                # (duplicates mode only; config.__post_init__ validates)
+                count_dtype=(
+                    config.count_dtype
+                    if config.count_dtype == "wide"
+                    else jnp.dtype(config.count_dtype)
+                ),
             )
         if self._mesh is not None:
             from .parallel import shard_state
@@ -279,9 +295,10 @@ class ReservoirEngine:
                 return False
         elif jnp.dtype(tile_dtype) != self._state.samples.dtype:
             return False
-        if self._mesh is not None:
+        if self._mesh is not None and self._ops is not _algl:
             # under shard_map each chip runs the kernel on its own
-            # row-blocks; the per-shard reservoir count must still tile
+            # row-blocks; distinct/weighted still require the per-shard
+            # reservoir count to tile (the Algorithm-L kernel pads)
             n_shards = self._mesh.shape[self._config.mesh_axis]
             if (
                 self._config.num_reservoirs // n_shards

@@ -36,9 +36,25 @@ import jax.numpy as jnp
 import jax.random as jr
 import numpy as np
 
-from .rng import accept_draws_words, key_words, uniforms
+from . import u64e
+from .rng import accept_draws_pair, accept_draws_words, key_words
 
-__all__ = ["ReservoirState", "init", "update", "update_steady", "result", "merge"]
+__all__ = [
+    "ReservoirState",
+    "WIDE",
+    "init",
+    "update",
+    "update_steady",
+    "result",
+    "merge",
+]
+
+#: ``count_dtype`` sentinel: carry ``count``/``nxt`` as emulated-uint64
+#: uint32 pairs (:mod:`reservoir_tpu.ops.u64e`) — streams past 2^31
+#: elements per reservoir with x64 OFF (VERDICT r2 item 5; the reference's
+#: ``count: Long``, ``Sampler.scala:203``).  Wide states take the XLA path
+#: (the Pallas kernel's ``supports()`` declines non-int32 counters).
+WIDE = "wide"
 
 
 class ReservoirState(NamedTuple):
@@ -47,10 +63,13 @@ class ReservoirState(NamedTuple):
 
     Attributes:
       samples: ``[R, k]``   stored samples (post-``map``).
-      count:   ``[R]`` int  elements consumed per reservoir.
-      nxt:     ``[R]`` int  absolute 1-based index of the next acceptance;
-               saturates at dtype max (sampling effectively stops there —
-               use int64/x64 for streams longer than 2^31 per reservoir).
+      count:   ``[R]`` int  elements consumed per reservoir — or
+               ``[R, 2]`` uint32 (lo, hi) emulated-uint64 planes when the
+               state was built with ``count_dtype=WIDE``.
+      nxt:     ``[R]`` int (or ``[R, 2]`` wide)  absolute 1-based index of
+               the next acceptance; narrow dtypes saturate at dtype max
+               (sampling effectively stops there — use ``WIDE`` for
+               streams longer than 2^31 per reservoir without x64).
       log_w:   ``[R]`` f32  log of Algorithm L's W.
       key:     ``[R]``      per-reservoir PRNG keys (split once at init).
     """
@@ -68,6 +87,11 @@ class ReservoirState(NamedTuple):
     @property
     def k(self) -> int:
         return self.samples.shape[1]
+
+    @property
+    def wide(self) -> bool:
+        """Whether counters are emulated-uint64 planes (``count_dtype=WIDE``)."""
+        return self.count.ndim == 2
 
 
 def _advance(log_w: jax.Array, nxt: jax.Array, key: jax.Array, idx, k: int):
@@ -103,6 +127,34 @@ def _advance_words(
     return slot, log_w, nxt
 
 
+def _advance_pair(
+    log_w: jax.Array,
+    nxt: jax.Array,
+    k1: jax.Array,
+    k2: jax.Array,
+    idx_hi: jax.Array,
+    idx_lo: jax.Array,
+    k: int,
+):
+    """:func:`_advance_words` for WIDE (emulated-uint64) counters.
+
+    ``nxt`` is a ``[..., 2]`` uint32 pair; draws are keyed on the
+    ``(idx_hi, idx_lo)`` absolute index — bit-identical to the int64 path
+    for the same logical index (same Threefry block), and the skip
+    arithmetic (`f32 -> hi/lo split`) is exact, so wide and int64 states
+    evolve bit-identically (``tests/test_wide_count.py``).
+    """
+    slot, u1, u2 = accept_draws_pair(k1, k2, idx_hi, idx_lo, k)
+    log_w = log_w + jnp.log(u1) / k
+    w = jnp.exp(log_w)
+    skip_f = jnp.floor(jnp.log(u2) / jnp.log1p(-w))
+    # clamp below 2^62: headroom for the uint64 adds (a skip that large is
+    # unreachable anyway — it exceeds any feedable stream)
+    skip_f = jnp.minimum(skip_f, float(2.0**62))
+    nxt = u64e.add_f32(u64e.add_u32(nxt, jnp.uint32(1)), skip_f)
+    return slot, log_w, nxt
+
+
 def init(
     key: jax.Array,
     num_reservoirs: int,
@@ -113,10 +165,29 @@ def init(
     """Create R empty reservoirs (ctor path, ``Sampler.scala:196-207``).
 
     Device buffers are statically shaped at ``[R, k]`` — the ``preAllocate``
-    mode of the reference is the only mode XLA admits.
+    mode of the reference is the only mode XLA admits.  ``count_dtype=WIDE``
+    selects emulated-uint64 counters (no x64 needed; see :data:`WIDE`).
     """
-    count_dtype = jnp.dtype(count_dtype)
     keys = jr.split(key, num_reservoirs)
+    if isinstance(count_dtype, str) and count_dtype == WIDE:
+
+        def one_wide(key_r):
+            log_w0 = jnp.zeros((), jnp.float32)
+            nxt0 = u64e.from_int(k)
+            zero = jnp.zeros((), jnp.uint32)
+            k1, k2 = key_words(key_r)
+            _, log_w, nxt = _advance_pair(log_w0, nxt0, k1, k2, zero, zero, k)
+            return log_w, nxt
+
+        log_w, nxt = jax.vmap(one_wide)(keys)
+        return ReservoirState(
+            samples=jnp.zeros((num_reservoirs, k), sample_dtype),
+            count=u64e.from_int(0, (num_reservoirs,)),
+            nxt=nxt,
+            log_w=log_w,
+            key=keys,
+        )
+    count_dtype = jnp.dtype(count_dtype)
 
     def one(key_r):
         log_w0 = jnp.zeros((), jnp.float32)
@@ -152,17 +223,30 @@ def _accept_loop(
     The vmapped ``while_loop`` runs until the slowest lane is done; lanes with
     no acceptance in the tile cost one compare (the hot-path property,
     ``Sampler.scala:257``).
+
+    Wide (emulated-uint64) counters take the same loop with pair
+    arithmetic: 64-bit compares/adds on uint32 planes, and tile-local
+    positions via a low-word difference (always < B, so int32-exact).
     """
+    wide = count.ndim == 1  # per-lane: narrow counters are scalars
 
     def cond(carry):
         _, nxt_c, _ = carry
-        return nxt_c <= end
+        return u64e.le(nxt_c, end) if wide else nxt_c <= end
 
     def body(carry):
         samples_c, nxt_c, log_w_c = carry
-        pos = (nxt_c - count - 1).astype(jnp.int32)  # local index in [0, B)
-        elem = batch[pos]  # OOB-clamped gather is discarded for done lanes
-        slot, log_w_n, nxt_n = _advance(log_w_c, nxt_c, key, nxt_c, k)
+        if wide:
+            pos = u64e.diff_small(nxt_c, count) - 1  # local index in [0, B)
+            elem = batch[pos]
+            k1, k2 = key_words(key)
+            slot, log_w_n, nxt_n = _advance_pair(
+                log_w_c, nxt_c, k1, k2, u64e.hi(nxt_c), u64e.lo(nxt_c), k
+            )
+        else:
+            pos = (nxt_c - count - 1).astype(jnp.int32)  # local index in [0, B)
+            elem = batch[pos]  # OOB-clamped gather is discarded for done lanes
+            slot, log_w_n, nxt_n = _advance(log_w_c, nxt_c, key, nxt_c, k)
         value = map_fn(elem) if map_fn is not None else elem
         samples_n = samples_c.at[slot].set(jnp.asarray(value, samples_c.dtype))
         return samples_n, nxt_n, log_w_n
@@ -184,19 +268,38 @@ def _update_one(
     fill: bool,
 ):
     """Single-reservoir tile update (vmapped over R by :func:`update`)."""
-    count_dtype = state_count.dtype
+    wide = state_count.ndim == 1  # per-lane: [2] planes vs scalar
     bsz = batch.shape[0]
-    end = state_count + valid.astype(count_dtype)
+    if wide:
+        end = u64e.add_u32(state_count, valid.astype(jnp.uint32))
+    else:
+        count_dtype = state_count.dtype
+        end = state_count + valid.astype(count_dtype)
 
     samples = state_samples
     if fill:
         # fill phase (Sampler.scala:253-255): element with absolute index
         # idx <= k goes to slot idx-1, in arrival order.  map applies on
         # accept; fill elements are all accepted.
-        idx = state_count + jnp.arange(1, bsz + 1, dtype=count_dtype)
         in_tile = jnp.arange(bsz) < valid
-        fill_mask = (idx <= k) & in_tile
-        dest = jnp.where(fill_mask, (idx - 1).astype(jnp.int32), k)  # k -> dropped
+        if wide:
+            # fills only exist while count < k (small), so the low word
+            # alone decides — guarded on hi == 0 and lo < k, which also
+            # rules out low-word wraparound in the local index sum
+            lo_idx = u64e.lo(state_count) + jnp.arange(
+                1, bsz + 1, dtype=jnp.uint32
+            )
+            fill_mask = (
+                (u64e.hi(state_count) == 0)
+                & (u64e.lo(state_count) < k)
+                & (lo_idx <= k)
+                & in_tile
+            )
+            dest = jnp.where(fill_mask, (lo_idx - 1).astype(jnp.int32), k)
+        else:
+            idx = state_count + jnp.arange(1, bsz + 1, dtype=count_dtype)
+            fill_mask = (idx <= k) & in_tile
+            dest = jnp.where(fill_mask, (idx - 1).astype(jnp.int32), k)  # k -> dropped
         values = map_fn(batch) if map_fn is not None else batch
         samples = samples.at[dest].set(
             jnp.asarray(values, samples.dtype), mode="drop"
@@ -299,23 +402,38 @@ def merge_samples(
     a merged history are not reconstructible); keep per-shard states live to
     continue streaming.
 
-    Counts enter the pick probabilities as f32: exact below 2^24 elements
-    per shard pair, O(2^-24)-biased beyond.
+    Pick probabilities use EXACT integer arithmetic (:func:`_randint_exact`):
+    draw ``r`` uniform in ``[0, rem_a + rem_b)`` and take from A iff
+    ``r < rem_a`` — exact at any magnitude the count dtype holds (the former
+    f32 compare was O(2^-24)-biased past 2^24 elements per shard pair).
     """
     k = samples_a.shape[1]
+    if count_a.ndim == 2 or count_b.ndim == 2:
+        raise NotImplementedError(
+            "merge_samples on WIDE (emulated-uint64) counts is not "
+            "supported: the hypergeometric pick needs 64-bit integer "
+            "arithmetic — enable x64 and use int64 counters to merge "
+            "streams beyond 2^32 elements per shard pair"
+        )
 
     def one(s_a, c_a, s_b, c_b, key_r):
         sz_a = jnp.minimum(c_a, k)
         sz_b = jnp.minimum(c_b, k)
         total = c_a + c_b
         m = jnp.minimum(total, k).astype(jnp.int32)
+        kw1, kw2 = key_words(key_r)
 
         def step(carry, t):
             rem_a, rem_b, j_a = carry
-            u = _uniform01(key_r, t)
-            denom = (rem_a + rem_b).astype(jnp.float32)
-            pick_a = (u * denom < rem_a.astype(jnp.float32)) & (rem_a > 0)
-            pick_a = pick_a | (rem_b <= 0)
+            from .threefry import fold_in_words
+
+            f1, f2 = fold_in_words(kw1, kw2, t)
+            denom = jnp.maximum(rem_a + rem_b, 1)  # inactive lanes: denom 0
+            r = _randint_exact(f1, f2, denom)
+            # r uniform in [0, rem_a + rem_b) makes the edge guards of the
+            # f32 version redundant: rem_a == 0 -> never picks A,
+            # rem_b == 0 -> r < rem_a always
+            pick_a = r < rem_a
             active = t < m
             take_a = active & pick_a
             take_b = active & ~pick_a
@@ -358,8 +476,53 @@ def merge(
     return samples, size, count
 
 
-def _uniform01(key: jax.Array, idx) -> jax.Array:
-    return uniforms(key, idx, offset=0.5)
+def _randint_exact(f1: jax.Array, f2: jax.Array, denom: jax.Array) -> jax.Array:
+    """EXACT uniform integer in ``[0, denom)`` for folded key ``(f1, f2)``.
+
+    Rejection over fresh 32-bit draws (64-bit when ``denom`` is an int64 —
+    which implies x64 is on): accept a draw below the largest multiple of
+    ``denom`` in the word space, then reduce mod ``denom``.  Expected
+    attempts < 2 (worst case ``denom`` near the half-space); each attempt
+    ``a`` hashes block ``(1, a)`` of the folded key — disjoint from the
+    ``(0, j)`` blocks every other consumer draws (:func:`..threefry.bits_words`).
+
+    This replaces the former f32 ``u * denom < rem`` compare whose count
+    arithmetic was O(2^-24)-biased past 2^24 elements (VERDICT r2 item 7):
+    integer compares are exact at any magnitude the count dtype holds.
+    ``denom`` must be >= 1 (callers mask inactive lanes).
+    """
+    from .threefry import threefry2x32
+
+    wide = jnp.dtype(denom.dtype).itemsize == 8
+    one_blk = jnp.ones_like(jnp.asarray(f1, jnp.uint32))
+    if wide:
+        ud = denom.astype(jnp.uint64)
+        space_mod = ((jnp.uint64(0xFFFFFFFFFFFFFFFF) % ud) + 1) % ud
+    else:
+        ud = denom.astype(jnp.uint32)
+        space_mod = ((jnp.uint32(0xFFFFFFFF) % ud) + 1) % ud
+    # accept bits < 2^w - (2^w mod denom); space_mod == 0 (denom a power of
+    # two dividing the space) accepts everything
+    thresh = jnp.zeros_like(space_mod) - space_mod
+
+    def draw(a):
+        b0, b1 = threefry2x32(f1, f2, one_blk, one_blk * jnp.uint32(0) + a)
+        if wide:
+            return (b0.astype(jnp.uint64) << 32) | b1.astype(jnp.uint64)
+        return b0 ^ b1
+
+    def cond(carry):
+        _, bits = carry
+        return ~((space_mod == 0) | (bits < thresh))
+
+    def body(carry):
+        a, _ = carry
+        return a + jnp.uint32(1), draw(a + jnp.uint32(1))
+
+    _, bits = jax.lax.while_loop(
+        cond, body, (jnp.uint32(0), draw(jnp.uint32(0)))
+    )
+    return (bits % ud).astype(denom.dtype)
 
 
 def _masked_perm(key: jax.Array, k: int, size) -> jax.Array:
@@ -374,7 +537,16 @@ def result(state: ReservoirState) -> Tuple[jax.Array, jax.Array]:
     """Device-side result: ``(samples [R, k], size [R])`` where
     ``size = min(count, k)`` (truncation contract, ``Sampler.scala:318-331``).
     Host wrappers slice ``samples[r, :size[r]]``; entries beyond ``size`` are
-    zeros, never sampled data."""
-    size = jnp.minimum(state.count, state.k).astype(state.count.dtype)
+    zeros, never sampled data.  ``size`` is int32 for wide states (k is
+    always < 2^31)."""
+    if state.wide:
+        lo = u64e.lo(state.count)
+        size = jnp.where(
+            (u64e.hi(state.count) > 0) | (lo >= state.k),
+            jnp.int32(state.k),
+            lo.astype(jnp.int32),
+        )
+    else:
+        size = jnp.minimum(state.count, state.k).astype(state.count.dtype)
     mask = jnp.arange(state.k)[None, :] < size[:, None]
     return jnp.where(mask, state.samples, jnp.zeros_like(state.samples)), size

@@ -22,7 +22,9 @@ Scope (``ReservoirEngine._update_fn`` dispatches here via :func:`supports`
 and falls back to the XLA path otherwise): steady state only
 (every reservoir past its fill phase — the reference's hot regime,
 ``Sampler.scala:257``), full tiles (no ``valid`` raggedness), identity
-``map_fn``, int32 counters, and R divisible by the row-block size.
+``map_fn``, int32 counters.  Any R: reservoir rows that do not fill the
+last row-block are padded with inert lanes (``nxt`` pinned past the tile,
+so they take zero acceptance rounds) and sliced off after.
 """
 
 from __future__ import annotations
@@ -31,32 +33,52 @@ import functools
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from .algorithm_l import ReservoirState, _advance_words
 from .rng import key_words
 
-__all__ = ["supports", "update_steady_pallas"]
+__all__ = ["supports", "pick_block_r", "update_steady_pallas"]
 
 _DEFAULT_BLOCK_R = 64
+# one-hot batch gathers are chunked to this many lanes per instruction:
+# full-width [block_r, B] selects+reduces in the acceptance while_loop are
+# the prime Mosaic compile-time suspect past block 64 (BENCH.md r2: block
+# 128 compiled >6 min); fixed-width chunks keep each op's vreg footprint
+# constant as block_r/B grow.  Integer sums over disjoint chunks stay
+# exact, so bit-equivalence with the XLA path is unaffected.
+_GATHER_CHUNK_B = 512
+
+
+def pick_block_r(num_reservoirs: int, k: int, tile_b: int) -> int:
+    """VMEM-aware row-block (ops.blocking): ~2 k-wide planes (samples
+    in + out) and ~4 B-wide planes (batch + gather temps), 4 bytes each."""
+    from .blocking import pick_block_r as _pick
+
+    return _pick(num_reservoirs, (2 * k + 4 * tile_b) * 4, _DEFAULT_BLOCK_R)
 
 
 def supports(
     state: ReservoirState,
     valid,
     map_fn,
-    block_r: int = _DEFAULT_BLOCK_R,
+    block_r: "int | None" = None,
     batch: "jax.Array | None" = None,
 ) -> bool:
-    """True iff this kernel can take the tile (else: XLA path)."""
+    """True iff this kernel can take the tile (else: XLA path).
+
+    R-divisibility is no longer required — non-divisible R pads the last
+    row-block with inert lanes.
+    """
     return (
         valid is None
         and map_fn is None
+        and state.count.ndim == 1  # WIDE (emulated-uint64) states: XLA path
         and state.count.dtype == jnp.int32
         and state.samples.dtype in (jnp.int32, jnp.float32, jnp.uint32)
         and (batch is None or batch.dtype == state.samples.dtype)
-        and state.num_reservoirs % block_r == 0
     )
 
 
@@ -75,7 +97,11 @@ def _kernel(samples_ref, count_ref, nxt_ref, logw_ref, key_ref, batch_ref,
     k2 = key_ref[:, 1:2]
     block_r = count.shape[0]
 
-    lane_b = jax.lax.broadcasted_iota(jnp.int32, (block_r, block_b), 1)
+    chunk_b = min(block_b, _GATHER_CHUNK_B)
+    if block_b % chunk_b != 0:  # odd widths: one full-width gather
+        chunk_b = block_b
+    n_chunks = block_b // chunk_b
+    lane_c = jax.lax.broadcasted_iota(jnp.int32, (block_r, chunk_b), 1)
     lane_k = jax.lax.broadcasted_iota(jnp.int32, (block_r, k), 1)
 
     # out refs start as copies of the inputs; acceptances mutate in place.
@@ -90,16 +116,30 @@ def _kernel(samples_ref, count_ref, nxt_ref, logw_ref, key_ref, batch_ref,
         active = nxt <= end                       # [r, 1]
         pos = nxt - count - 1                     # [r, 1] in [0, B) when active
         # gather batch[r, pos_r] as a one-hot masked reduction (no per-row
-        # dynamic gather on the VPU)
-        onehot = lane_b == pos
-        # one-hot gather as an integer bit-pattern sum: exactly one lane is
-        # selected and the rest contribute literal zero, so summing the
-        # bitcast int32 words is exact for every dtype — including the
-        # float32 -0.0 sign bit, which a float sum would drop (-0.0 + 0.0
-        # == +0.0 in IEEE)
-        batch_bits = jax.lax.bitcast_convert_type(batch_ref[:, :], jnp.int32)
-        elem_bits = jnp.sum(
-            jnp.where(onehot, batch_bits, 0), axis=1, keepdims=True
+        # dynamic gather on the VPU), CHUNKED over the batch axis so each
+        # select+reduce touches a fixed [r, chunk_b] window — constant vreg
+        # footprint per instruction regardless of B (Mosaic compile-time
+        # control, see _GATHER_CHUNK_B).
+        # The sum is over integer bit patterns: exactly one lane across all
+        # chunks is selected and the rest contribute literal zero, so the
+        # total is exact for every dtype — including the float32 -0.0 sign
+        # bit, which a float sum would drop (-0.0 + 0.0 == +0.0 in IEEE).
+        def gather_chunk(c, acc):
+            off = c * chunk_b
+            bits = jax.lax.bitcast_convert_type(
+                batch_ref[:, pl.dslice(off, chunk_b)], jnp.int32
+            )
+            onehot = lane_c == (pos - off)
+            return acc + jnp.sum(
+                jnp.where(onehot, bits, 0), axis=1, keepdims=True
+            )
+
+        elem_bits = jax.lax.fori_loop(
+            0,
+            n_chunks,
+            gather_chunk,
+            jnp.zeros((block_r, 1), jnp.int32),
+            unroll=False,
         )
         elem = jax.lax.bitcast_convert_type(elem_bits, batch_ref.dtype)
         slot, log_w_n, nxt_n = _advance_words(log_w, nxt, k1, k2, nxt, k)
@@ -121,7 +161,7 @@ def update_steady_pallas(
     state: ReservoirState,
     batch: jax.Array,
     *,
-    block_r: int = _DEFAULT_BLOCK_R,
+    block_r: "int | None" = None,
     interpret: bool = False,
 ) -> ReservoirState:
     """Steady-state tile update, bit-identical to
@@ -129,7 +169,10 @@ def update_steady_pallas(
 
     ``batch`` is ``[R, B]``; reservoir r consumes its full row.  Requires
     :func:`supports`; ``interpret=True`` runs the Mosaic interpreter (CPU
-    equivalence tests).
+    equivalence tests).  ``block_r=None`` auto-sizes the row-block
+    (VMEM-aware, :func:`pick_block_r`); any R is accepted — a partial last
+    row-block is padded with inert lanes (``nxt`` pinned past the tile end,
+    so their acceptance loop never iterates) and sliced off.
     """
     R, k = state.samples.shape
     B = batch.shape[1]
@@ -140,9 +183,31 @@ def update_steady_pallas(
     if not supports(state, None, None, block_r, batch):
         raise ValueError(
             "update_steady_pallas: unsupported config (need int32 counters, "
-            f"int32/float32/uint32 samples, batch dtype == samples dtype, "
-            f"R % {block_r} == 0); use ops.algorithm_l.update_steady"
+            "int32/float32/uint32 samples, batch dtype == samples dtype); "
+            "use ops.algorithm_l.update_steady"
         )
+    if block_r is None:
+        block_r = pick_block_r(R, k, B)
+    R_orig = R
+    if R % block_r != 0:
+        if R < block_r:
+            block_r = 1 << max(0, (R.bit_length() - 1))  # pow2 <= R
+        pad = (-R) % block_r
+        if pad:
+            # inert pad lanes: count 0, nxt = B + 1 > end, so cond() is
+            # false for them from the first round — zero extra work beyond
+            # the block's lockstep rides
+            state = ReservoirState(
+                samples=jnp.pad(state.samples, ((0, pad), (0, 0))),
+                count=jnp.pad(state.count, (0, pad)),
+                nxt=jnp.pad(
+                    state.nxt, (0, pad), constant_values=np.int32(B + 1)
+                ),
+                log_w=jnp.pad(state.log_w, (0, pad)),
+                key=jnp.concatenate([state.key, state.key[-pad:]]),
+            )
+            batch = jnp.pad(batch, ((0, pad), (0, 0)))
+            R = R + pad
     kd1, kd2 = key_words(state.key)               # [R] uint32 each
     key_data = jnp.stack([kd1, kd2], axis=1)      # [R, 2]
 
@@ -177,10 +242,15 @@ def update_steady_pallas(
         key_data,
         batch,
     )
+    if R != R_orig:  # drop the inert pad lanes
+        out_samples = out_samples[:R_orig]
+        out_nxt = out_nxt[:R_orig]
+        out_logw = out_logw[:R_orig]
+        state = jax.tree.map(lambda x: x[:R_orig], state)
     return ReservoirState(
         samples=out_samples,
         count=state.count + jnp.asarray(B, state.count.dtype),
-        nxt=out_nxt.reshape(R),
-        log_w=out_logw.reshape(R),
+        nxt=out_nxt.reshape(R_orig),
+        log_w=out_logw.reshape(R_orig),
         key=state.key,
     )

@@ -20,11 +20,12 @@ import jax
 import jax.numpy as jnp
 import jax.random as jr
 
-from .threefry import counter_bits
+from .threefry import counter_bits, counter_bits_pair
 
 __all__ = [
     "accept_draws",
     "accept_draws_words",
+    "accept_draws_pair",
     "key_words",
     "uniform_from_bits",
     "uniforms",
@@ -93,6 +94,20 @@ def accept_draws_words(k1: jax.Array, k2: jax.Array, idx: jax.Array, k: int):
     ``pallas_call`` boundary).  64-bit ``idx`` keeps fresh draws past 2^32
     (see :func:`reservoir_tpu.ops.threefry.fold_in_words`)."""
     w0, w1, w2 = counter_bits(k1, k2, idx, 3)
+    u1 = uniform_from_bits(w0)
+    u2 = uniform_from_bits(w1)
+    slot = (w2 % jnp.uint32(k)).astype(jnp.int32)
+    return slot, u1, u2
+
+
+def accept_draws_pair(
+    k1: jax.Array, k2: jax.Array, idx_hi: jax.Array, idx_lo: jax.Array, k: int
+):
+    """:func:`accept_draws_words` for an absolute index carried as
+    ``(hi, lo)`` uint32 words (emulated-uint64 counters,
+    :mod:`reservoir_tpu.ops.u64e`) — bit-identical to the int64 path for
+    the same logical index."""
+    w0, w1, w2 = counter_bits_pair(k1, k2, idx_hi, idx_lo, 3)
     u1 = uniform_from_bits(w0)
     u2 = uniform_from_bits(w1)
     slot = (w2 % jnp.uint32(k)).astype(jnp.int32)

@@ -29,7 +29,14 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-__all__ = ["threefry2x32", "fold_in_words", "bits_words", "counter_bits"]
+__all__ = [
+    "threefry2x32",
+    "fold_in_words",
+    "fold_in_words_pair",
+    "bits_words",
+    "counter_bits",
+    "counter_bits_pair",
+]
 
 _PARITY = np.uint32(0x1BD11BDA)
 # Rotation schedule for Threefry-2x32, 20 rounds in 5 groups of 4.
@@ -84,6 +91,19 @@ def fold_in_words(
     return threefry2x32(k1, k2, hi, lo)
 
 
+def fold_in_words_pair(
+    k1: jax.Array, k2: jax.Array, idx_hi: jax.Array, idx_lo: jax.Array
+) -> Tuple[jax.Array, jax.Array]:
+    """:func:`fold_in_words` for a 64-bit index carried as explicit
+    ``(hi, lo)`` uint32 words — the form emulated-uint64 state uses when
+    x64 is off (:mod:`reservoir_tpu.ops.u64e`).  Bit-identical to
+    ``fold_in_words(k1, k2, (hi << 32) | lo)`` by construction: both hash
+    the block ``(hi, lo)``."""
+    return threefry2x32(
+        k1, k2, jnp.asarray(idx_hi, jnp.uint32), jnp.asarray(idx_lo, jnp.uint32)
+    )
+
+
 def bits_words(k1: jax.Array, k2: jax.Array, n: int):
     """``jr.bits(key, (n,), uint32)`` on raw words, for small static ``n``:
     word ``j`` comes from block ``(0, j)`` as ``out0 ^ out1`` (jax's
@@ -107,4 +127,13 @@ def counter_bits(k1: jax.Array, k2: jax.Array, idx: jax.Array, n: int):
     idx < 2^32; for 64-bit ``idx`` the full index is folded in (see
     :func:`fold_in_words`)."""
     f1, f2 = fold_in_words(k1, k2, idx)
+    return bits_words(f1, f2, n)
+
+
+def counter_bits_pair(
+    k1: jax.Array, k2: jax.Array, idx_hi: jax.Array, idx_lo: jax.Array, n: int
+):
+    """:func:`counter_bits` for an index carried as ``(hi, lo)`` words —
+    bit-identical to the int64 path for the same logical index."""
+    f1, f2 = fold_in_words_pair(k1, k2, idx_hi, idx_lo)
     return bits_words(f1, f2, n)

@@ -1,0 +1,116 @@
+"""Emulated unsigned-64-bit integers on uint32 planes (no x64 needed).
+
+Algorithm L's stream positions (``count``/``nxt``) saturate int32 past
+~2.1e9 elements per reservoir, and the int64 escape hatch needs global
+x64 (VERDICT r2 item 5).  Distinct mode already solved
+64-bit-without-x64 with uint32 bit-planes for *values*
+(``ops/distinct.py``); this module applies the same trick to *counters*:
+a logical uint64 is a uint32 array with a trailing axis of 2 —
+``[..., 0]`` the low word, ``[..., 1]`` the high word.
+
+Only the operations the Algorithm-L hot path needs are provided; all are
+elementwise over leading axes and Pallas-compatible (pure jnp bitwise/
+compare ops).  The float path (``add_f32``) is exact for every step:
+``floor(f * 2^-32)`` and the remainder are both exactly representable in
+f32 (the remainder is a multiple of the f32 grid at the value's exponent),
+so wide arithmetic is bit-identical to the int64 path fed the same f32
+skip — pinned by ``tests/test_wide_count.py``.
+
+Reference: ``Sampler.scala:203`` (``count: Long``) — the contract this
+restores without global x64.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "make",
+    "from_int",
+    "lo",
+    "hi",
+    "add_u32",
+    "add_f32",
+    "le",
+    "diff_small",
+    "to_f32",
+    "to_int",
+]
+
+_TWO32 = float(2.0**32)
+
+
+def make(lo_w: jax.Array, hi_w: jax.Array) -> jax.Array:
+    """Pack ``(lo, hi)`` uint32 words into the trailing-axis-2 layout."""
+    return jnp.stack(
+        [jnp.asarray(lo_w, jnp.uint32), jnp.asarray(hi_w, jnp.uint32)], axis=-1
+    )
+
+
+def from_int(value: int, shape=()) -> jax.Array:
+    """A constant logical uint64 broadcast to ``shape + (2,)``."""
+    value = int(value)
+    lo_w = jnp.full(shape, value & 0xFFFFFFFF, jnp.uint32)
+    hi_w = jnp.full(shape, (value >> 32) & 0xFFFFFFFF, jnp.uint32)
+    return make(lo_w, hi_w)
+
+
+def lo(a: jax.Array) -> jax.Array:
+    return a[..., 0]
+
+
+def hi(a: jax.Array) -> jax.Array:
+    return a[..., 1]
+
+
+def add_u32(a: jax.Array, d) -> jax.Array:
+    """``a + d`` for ``d`` a uint32 (carry-propagating)."""
+    d = jnp.asarray(d, jnp.uint32)
+    lo_n = a[..., 0] + d
+    carry = (lo_n < a[..., 0]).astype(jnp.uint32)  # wrapped iff smaller
+    return make(lo_n, a[..., 1] + carry)
+
+
+def add_f32(a: jax.Array, f: jax.Array) -> jax.Array:
+    """``a + floor(f)`` for non-negative f32 ``f`` (< 2^63).
+
+    The hi/lo split of ``f`` is exact in f32 (exponent-shift multiply,
+    exact floor, and a remainder on the same mantissa grid), so this is
+    bit-identical to ``a + f.astype(int64)`` under x64.
+    """
+    f = jnp.maximum(f, 0.0)
+    hi_f = jnp.floor(f * (1.0 / _TWO32))
+    rem = f - hi_f * _TWO32  # exact: multiple of the grid at f's exponent
+    lo_n = a[..., 0] + rem.astype(jnp.uint32)
+    carry = (lo_n < a[..., 0]).astype(jnp.uint32)
+    return make(lo_n, a[..., 1] + hi_f.astype(jnp.uint32) + carry)
+
+
+def le(a: jax.Array, b: jax.Array) -> jax.Array:
+    """``a <= b`` as 64-bit unsigned lexicographic compare."""
+    return (a[..., 1] < b[..., 1]) | (
+        (a[..., 1] == b[..., 1]) & (a[..., 0] <= b[..., 0])
+    )
+
+
+def diff_small(a: jax.Array, b: jax.Array) -> jax.Array:
+    """``a - b`` as int32 for differences known to fit int32 (e.g. a tile-
+    local position): wrap-around low-word subtraction, two's complement."""
+    return (a[..., 0] - b[..., 0]).astype(jnp.int32)
+
+
+def to_f32(a: jax.Array) -> jax.Array:
+    """Approximate float32 value (for stats/telemetry, not sampling state —
+    the single owner of the plane layout, so callers never index planes)."""
+    return a[..., 0].astype(jnp.float32) + _TWO32 * a[..., 1].astype(
+        jnp.float32
+    )
+
+
+def to_int(a) -> int:
+    """Host-side readback of a scalar logical value as a Python int."""
+    import numpy as np
+
+    arr = np.asarray(a)
+    return int(arr[..., 1]) * (1 << 32) + int(arr[..., 0])

@@ -9,8 +9,8 @@ with an exact merge that rides ICI collectives (SURVEY §5 long-context row).
 Mechanism per mode:
 
 - uniform (Algorithm L): hypergeometric pairwise merge
-  (:func:`reservoir_tpu.ops.algorithm_l.merge_samples`), folded across the
-  device axis after an ``all_gather``;
+  (:func:`reservoir_tpu.ops.algorithm_l.merge_samples`), combined across
+  the device axis by a log-depth tree after an ``all_gather``;
 - distinct: bottom-k union (shared salts across shards);
 - weighted: top-k union of ES keys.
 
@@ -21,11 +21,7 @@ collective-free.
 
 from __future__ import annotations
 
-import functools
-from typing import Tuple
-
 import jax
-import jax.numpy as jnp
 import jax.random as jr
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
@@ -46,9 +42,12 @@ def uniform_stream_merger(mesh: Mesh, axis: str = "stream"):
     sample, replicated on every device.
 
     Inputs are the stacked per-shard results, sharded ``P(axis)`` on the
-    leading device axis; the fold happens after an ``all_gather`` over
+    leading device axis; the combine happens after an ``all_gather`` over
     ``axis`` and is identical on every device (same key), so the output is
-    replicated by construction.
+    replicated by construction.  The combine is a log-depth TREE of
+    pairwise merges (depth ``ceil(log2 D)``), not a sequential fold —
+    D is static, so the tree unrolls at trace time and XLA runs each
+    level's merges in parallel.
     """
     D = mesh.shape[axis]
 
@@ -57,18 +56,22 @@ def uniform_stream_merger(mesh: Mesh, axis: str = "stream"):
         g_s = jax.lax.all_gather(samples[0], axis)  # [D, R, k]
         g_c = jax.lax.all_gather(count[0], axis)  # [D, R]
 
-        def fold(carry, xs):
-            s, c = carry
-            s2, c2, step = xs
-            s, c = _algl.merge_samples(s, c, s2, c2, jr.fold_in(key, step))
-            return (s, c), None
-
-        (s, c), _ = jax.lax.scan(
-            fold,
-            (g_s[0], g_c[0]),
-            (g_s[1:], g_c[1:], jnp.arange(1, D)),
-        )
-        return s, c
+        items = [(g_s[d], g_c[d]) for d in range(D)]
+        node = 0
+        while len(items) > 1:
+            nxt = []
+            for i in range(0, len(items) - 1, 2):
+                node += 1
+                s, c = _algl.merge_samples(
+                    items[i][0], items[i][1],
+                    items[i + 1][0], items[i + 1][1],
+                    jr.fold_in(key, node),
+                )
+                nxt.append((s, c))
+            if len(items) % 2:
+                nxt.append(items[-1])
+            items = nxt
+        return items[0]
 
     return jax.jit(
         jax.shard_map(
@@ -82,19 +85,25 @@ def uniform_stream_merger(mesh: Mesh, axis: str = "stream"):
 
 
 def _summary_merger(mesh: Mesh, axis: str, pairwise, n_leaves: int):
-    """Shared all_gather + fold skeleton for key/hash-based merges (no RNG)."""
+    """Shared all_gather + log-depth tree combine for key/hash-based merges
+    (no RNG).  Depth ``ceil(log2 D)`` pairwise merges, unrolled at trace
+    time; each level's merges are independent, so XLA schedules them in
+    parallel."""
     D = mesh.shape[axis]
 
     def local(*leaves):
         gathered = [jax.lax.all_gather(leaf[0], axis) for leaf in leaves]
 
-        def fold(carry, xs):
-            return pairwise(carry, xs), None
-
-        carry0 = tuple(g[0] for g in gathered)
-        rest = tuple(g[1:] for g in gathered)
-        out, _ = jax.lax.scan(fold, carry0, rest)
-        return out
+        items = [tuple(g[d] for g in gathered) for d in range(D)]
+        while len(items) > 1:
+            nxt = [
+                pairwise(items[i], items[i + 1])
+                for i in range(0, len(items) - 1, 2)
+            ]
+            if len(items) % 2:
+                nxt.append(items[-1])
+            items = nxt
+        return items[0]
 
     return jax.jit(
         jax.shard_map(

@@ -133,7 +133,13 @@ def sharded_result(mesh: Mesh, axis: str = "res", ops=_algl):
 
             def body(st):
                 samples, sizes = ops.result(st)
-                total = jnp.sum(st.count)  # lowers to psum over the mesh
+                if st.count.ndim == 2:  # WIDE planes: f32 total (a stat,
+                    # not sampling state — counts this large exceed int32)
+                    from ..ops import u64e
+
+                    total = jnp.sum(u64e.to_f32(st.count))
+                else:
+                    total = jnp.sum(st.count)  # lowers to psum over the mesh
                 return samples, sizes, total
 
             fn = jax.jit(

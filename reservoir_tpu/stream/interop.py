@@ -1,0 +1,158 @@
+"""JVM/Akka interop: a socket server that backs the reference's ``Sample``
+stage with this framework's samplers (north-star clause, BASELINE.json).
+
+The reference's operator is an Akka ``GraphStage``
+(``akka-stream/.../Sample.scala:21-92``); this framework's native operator
+is :mod:`reservoir_tpu.stream.operator`.  For *existing Akka flows*, the
+bridge is this server plus the JVM-side shim stage in
+``examples/akka_interop/TpuSample.scala``: the stage keeps every Akka
+semantic locally (pass-through emit, backpressure, completion protocol,
+``SampleImpl.scala:27-57``) and delegates only the *sampling state* over a
+socket — ``sampler.sample(elem)`` becomes a buffered frame write, and
+``result()`` a final round-trip.  TCP flow control IS the backpressure
+coupling: if this server stalls, the stage's writes block and the stage
+backpressures its upstream, exactly like a slow in-process sampler.
+
+Wire protocol (all integers big-endian):
+
+  handshake  C->S:  magic ``RSV1`` | mode u8 (0 dup, 1 distinct) | k u32
+  frames     C->S:  ``B`` | count u32 | count x i64     (sample_all batch)
+             C->S:  ``C``                               (upstream complete)
+             C->S:  ``F``                               (failure/cancel-with-
+                                                         cause: discard)
+  result     S->C:  ``R`` | size u32 | size x i64       (reply to ``C``)
+             S->C:  ``A``                               (reply to ``F``)
+
+The completion protocol maps 1:1 onto ``SampleImpl.scala``'s:
+``onUpstreamFinish``/graceful ``onDownstreamFinish`` send ``C`` (deliver
+the sample, ``:38-41, 48-52``); ``onUpstreamFailure``/cancel-with-cause
+send ``F`` (``:43-46, 53-54``); dropping the connection without either is
+the ``postStop`` abrupt-termination analog (``:56-57``) — the server
+discards the partial sample.
+
+Elements are i64 on the wire (the ``Sampler[Long, Long]`` shape of
+BASELINE config 1).  ``map``/``hash`` hooks stay JVM-side: the shim
+applies ``map`` to the *returned* elements, which yields identical
+results for pure functions but calls ``map`` once per result element
+instead of once per accept — the one observable deviation, documented in
+ARCHITECTURE.md.
+"""
+
+from __future__ import annotations
+
+import socket
+import socketserver
+import struct
+import threading
+from typing import Callable, Optional
+
+import numpy as np
+
+__all__ = ["SampleServer"]
+
+_MAGIC = b"RSV1"
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed mid-frame")
+        buf += chunk
+    return buf
+
+
+class _Handler(socketserver.BaseRequestHandler):
+    def handle(self) -> None:  # one connection == one materialization
+        sock = self.request
+        head = _recv_exact(sock, len(_MAGIC) + 1 + 4)
+        if head[: len(_MAGIC)] != _MAGIC:
+            sock.close()
+            return
+        mode = head[len(_MAGIC)]
+        (k,) = struct.unpack(">I", head[len(_MAGIC) + 1 :])
+        sampler = self.server._make_sampler(mode, k)  # type: ignore[attr-defined]
+        try:
+            while True:
+                tag = _recv_exact(sock, 1)
+                if tag == b"B":
+                    (count,) = struct.unpack(">I", _recv_exact(sock, 4))
+                    data = _recv_exact(sock, 8 * count)
+                    elems = np.frombuffer(data, dtype=">i8").astype(np.int64)
+                    sampler.sample_all(elems)
+                elif tag == b"C":
+                    res = np.asarray(sampler.result(), dtype=np.int64)
+                    sock.sendall(
+                        b"R"
+                        + struct.pack(">I", res.shape[0])
+                        + res.astype(">i8").tobytes()
+                    )
+                    return
+                elif tag == b"F":
+                    # failure/cancel-with-cause: discard the partial sample
+                    # (the future fails JVM-side, SampleImpl.scala:43-46)
+                    sock.sendall(b"A")
+                    return
+                else:
+                    raise ConnectionError(f"unknown frame tag {tag!r}")
+        except ConnectionError:
+            # abrupt termination (postStop analog): nothing to deliver
+            return
+
+
+class SampleServer:
+    """Serve reference-``Sample`` materializations over TCP.
+
+    One connection per stream materialization; each gets a FRESH sampler
+    from ``sampler_factory(mode, k)`` (the by-name-thunk semantics of
+    ``Sample.scala:23-24``).  The default factory uses the host samplers
+    (:mod:`reservoir_tpu.api`); pass a factory returning a
+    :class:`~reservoir_tpu.stream.bridge.DeviceSampler` to put the
+    sampling state on the TPU.
+
+    Usage::
+
+        with SampleServer() as srv:        # srv.address -> ("127.0.0.1", p)
+            ...  # point the JVM shim at srv.address and run the Akka graph
+    """
+
+    def __init__(
+        self,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        sampler_factory: Optional[Callable[[int, int], object]] = None,
+    ) -> None:
+        self._factory = sampler_factory or self._default_factory
+        self._server = socketserver.ThreadingTCPServer(
+            (host, port), _Handler, bind_and_activate=True
+        )
+        self._server.daemon_threads = True
+        self._server._make_sampler = self._factory  # type: ignore[attr-defined]
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True
+        )
+
+    @staticmethod
+    def _default_factory(mode: int, k: int):
+        from .. import api
+
+        return api.distinct(k) if mode == 1 else api.sampler(k)
+
+    @property
+    def address(self):
+        return self._server.server_address
+
+    def start(self) -> "SampleServer":
+        self._thread.start()
+        return self
+
+    def close(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+
+    def __enter__(self) -> "SampleServer":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.close()

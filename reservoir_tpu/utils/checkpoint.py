@@ -173,7 +173,9 @@ def _config_to_jsonable(config) -> dict:
     d = dataclasses.asdict(config)
     for key, value in d.items():
         if key.endswith("_dtype") and value is not None:
-            d[key] = jnp.dtype(value).name
+            # "wide" is a count-dtype sentinel (emulated-uint64 planes),
+            # not a numpy dtype — persist it verbatim
+            d[key] = value if value == "wide" else jnp.dtype(value).name
     return d
 
 

@@ -141,9 +141,17 @@ class TestPallasDispatch:
         assert not e.pallas_used()
 
     def test_forced_pallas_rejects_ineligible_configs(self):
+        # duplicates mode accepts ANY R now (the kernel pads partial
+        # row-blocks); distinct/weighted still require block divisibility
+        ReservoirEngine(
+            SamplerConfig(max_sample_size=8, num_reservoirs=60, impl="pallas")
+        )
         with pytest.raises(ValueError, match="divisible"):
             ReservoirEngine(
-                SamplerConfig(max_sample_size=8, num_reservoirs=60, impl="pallas")
+                SamplerConfig(
+                    max_sample_size=8, num_reservoirs=60,
+                    weighted=True, impl="pallas",
+                )
             )
         with pytest.raises(ValueError, match="default hash"):
             # the distinct kernel owns the default-hash embedding; a user

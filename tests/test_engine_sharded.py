@@ -171,8 +171,20 @@ def test_engine_sharded_pallas_bit_identical():
 
 
 def test_engine_sharded_pallas_rejects_untileable_shard():
-    # 8 devices x block 64: R=256 gives 32 reservoirs/shard — constructor
-    # must fail fast (Sampler.scala:79-95 validation philosophy)
+    # 8 devices x block 64: R=256 gives 32 reservoirs/shard.  Duplicates
+    # mode now PADS partial row-blocks (any R); the weighted kernel still
+    # requires per-shard divisibility — constructor must fail fast
+    # (Sampler.scala:79-95 validation philosophy)
+    ReservoirEngine(
+        SamplerConfig(
+            max_sample_size=8,
+            num_reservoirs=256,
+            tile_size=32,
+            impl="pallas",
+            mesh_axis="res",
+        ),
+        key=1,
+    )
     with pytest.raises(ValueError, match="divisible"):
         ReservoirEngine(
             SamplerConfig(
@@ -181,6 +193,7 @@ def test_engine_sharded_pallas_rejects_untileable_shard():
                 tile_size=32,
                 impl="pallas",
                 mesh_axis="res",
+                weighted=True,
             ),
             key=1,
         )

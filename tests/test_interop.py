@@ -1,0 +1,146 @@
+"""SampleServer protocol tests — the Python half of the Akka shim.
+
+Drives the exact wire protocol the JVM ``TpuSample`` stage speaks
+(``examples/akka_interop/TpuSample.scala``), covering every
+completion-protocol branch of ``SampleImpl.scala:35-57``.
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+
+import numpy as np
+import pytest
+
+from reservoir_tpu.stream.interop import SampleServer
+
+
+def _connect(addr):
+    s = socket.create_connection(addr, timeout=10)
+    s.settimeout(10)
+    return s
+
+
+def _handshake(sock, mode: int, k: int) -> None:
+    sock.sendall(b"RSV1" + bytes([mode]) + struct.pack(">I", k))
+
+
+def _send_batch(sock, elems) -> None:
+    arr = np.asarray(elems, dtype=">i8")
+    sock.sendall(b"B" + struct.pack(">I", arr.shape[0]) + arr.tobytes())
+
+
+def _recv_exact(sock, n: int) -> bytes:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        assert chunk, "server closed early"
+        buf += chunk
+    return buf
+
+
+def _complete(sock):
+    sock.sendall(b"C")
+    assert _recv_exact(sock, 1) == b"R"
+    (size,) = struct.unpack(">I", _recv_exact(sock, 4))
+    return np.frombuffer(_recv_exact(sock, 8 * size), dtype=">i8").astype(
+        np.int64
+    )
+
+
+def test_uniform_sample_over_wire():
+    with SampleServer() as srv:
+        sock = _connect(srv.address)
+        _handshake(sock, mode=0, k=8)
+        _send_batch(sock, np.arange(1000, dtype=np.int64))
+        _send_batch(sock, 1000 + np.arange(500, dtype=np.int64))
+        res = _complete(sock)
+        sock.close()
+    assert res.shape == (8,)
+    assert set(res.tolist()) <= set(range(1500))
+
+
+def test_short_stream_returns_all_in_order():
+    with SampleServer() as srv:
+        sock = _connect(srv.address)
+        _handshake(sock, mode=0, k=50)
+        _send_batch(sock, [5, 6, 7])
+        res = _complete(sock)
+        sock.close()
+    assert res.tolist() == [5, 6, 7]  # arrival order below k
+
+
+def test_distinct_mode_dedups():
+    with SampleServer() as srv:
+        sock = _connect(srv.address)
+        _handshake(sock, mode=1, k=16)
+        _send_batch(sock, [7] * 100 + [9] * 50)
+        res = _complete(sock)
+        sock.close()
+    assert sorted(res.tolist()) == [7, 9]
+
+
+def test_failure_frame_discards():
+    with SampleServer() as srv:
+        sock = _connect(srv.address)
+        _handshake(sock, mode=0, k=8)
+        _send_batch(sock, np.arange(100, dtype=np.int64))
+        sock.sendall(b"F")
+        assert _recv_exact(sock, 1) == b"A"
+        sock.close()
+
+
+def test_abrupt_disconnect_is_tolerated():
+    with SampleServer() as srv:
+        sock = _connect(srv.address)
+        _handshake(sock, mode=0, k=8)
+        _send_batch(sock, np.arange(100, dtype=np.int64))
+        sock.close()  # postStop analog: no completion frame at all
+        # the server must keep serving new materializations
+        sock2 = _connect(srv.address)
+        _handshake(sock2, mode=0, k=4)
+        _send_batch(sock2, [1, 2])
+        assert _complete(sock2).tolist() == [1, 2]
+        sock2.close()
+
+
+def test_concurrent_materializations_are_independent():
+    with SampleServer() as srv:
+        socks = []
+        for i in range(4):
+            s = _connect(srv.address)
+            _handshake(s, mode=0, k=10)
+            _send_batch(s, np.arange(i * 100, i * 100 + 5, dtype=np.int64))
+            socks.append(s)
+        for i, s in enumerate(socks):
+            assert _complete(s).tolist() == list(range(i * 100, i * 100 + 5))
+            s.close()
+
+
+def test_device_sampler_factory_over_wire():
+    # the TPU-engine-backed path: a DeviceSampler holds the reservoir on
+    # the (CPU-mesh) device; the wire protocol is unchanged
+    from reservoir_tpu.config import SamplerConfig
+    from reservoir_tpu.stream.bridge import DeviceSampler
+
+    def factory(mode, k):
+        assert mode == 0
+        return DeviceSampler(
+            SamplerConfig(
+                max_sample_size=k,
+                num_reservoirs=1,
+                tile_size=64,
+                element_dtype="int32",
+            ),
+            key=0,
+        )
+
+    with SampleServer(sampler_factory=factory) as srv:
+        sock = _connect(srv.address)
+        _handshake(sock, mode=0, k=6)
+        _send_batch(sock, np.arange(300, dtype=np.int64))
+        res = _complete(sock)
+        sock.close()
+    assert res.shape == (6,)
+    assert set(res.tolist()) <= set(range(300))

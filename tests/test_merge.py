@@ -86,6 +86,78 @@ class TestPairwiseUniformMerge:
         assert np.all(np.abs(counts - R * p) < 5 * sigma), counts
 
 
+class TestExactIntegerPick:
+    """The merge's pick arithmetic is exact integer (VERDICT r2 item 7):
+    ``r ~ U[0, rem_a + rem_b)`` by rejection sampling, pick A iff
+    ``r < rem_a`` — no f32 rounding at any count magnitude."""
+
+    def test_randint_exact_rejection_unbiased(self):
+        # denom = 1.5e9: floor(2^32/denom) = 2, so a NAIVE `bits % denom`
+        # (no rejection) over-represents r < 2^32 - 2*denom by 50% —
+        # P(r < 1e9) would be ~0.6985 instead of the exact 2/3.  5-sigma
+        # over 1e5 draws separates the two by ~21 sigma.
+        from reservoir_tpu.ops.algorithm_l import _randint_exact
+        from reservoir_tpu.ops.rng import key_words
+        from reservoir_tpu.ops.threefry import fold_in_words
+
+        N, denom_v, cut = 100_000, 1_500_000_000, 1_000_000_000
+        k1, k2 = key_words(jr.key(42))
+        f1, f2 = fold_in_words(
+            jnp.broadcast_to(k1, (N,)), jnp.broadcast_to(k2, (N,)),
+            jnp.arange(N),
+        )
+        denom = jnp.full((N,), denom_v, jnp.int32)
+        r = np.asarray(jax.jit(jax.vmap(_randint_exact))(f1, f2, denom))
+        assert r.min() >= 0 and r.max() < denom_v
+        p = cut / denom_v
+        sigma = math.sqrt(N * p * (1 - p))
+        hits = int((r < cut).sum())
+        assert abs(hits - N * p) < 5 * sigma, hits
+
+    def test_merge_pick_distribution_is_hypergeometric(self):
+        # c_a=3, c_b=5, k=4: the count taken from A must follow
+        # Hypergeometric(8, 3, 4) with pmf [5, 30, 30, 5]/70.
+        R, k, n_a, n_b = 50_000, 4, 3, 5
+        a = al.update(
+            al.init(jr.key(20), R, k),
+            jnp.tile(jnp.arange(n_a, dtype=jnp.int32), (R, 1)),
+        )
+        b = al.update(
+            al.init(jr.key(21), R, k),
+            jnp.tile(10 + jnp.arange(n_b, dtype=jnp.int32), (R, 1)),
+        )
+        samples, count = al.merge_samples(
+            a.samples, a.count, b.samples, b.count, jr.key(22)
+        )
+        assert np.all(np.asarray(count) == n_a + n_b)
+        j_a = (np.asarray(samples) < 10).sum(axis=1)
+        pmf = np.array([5, 30, 30, 5]) / 70.0
+        for j in range(k):
+            sigma = math.sqrt(R * pmf[j] * (1 - pmf[j]))
+            got = int((j_a == j).sum())
+            assert abs(got - R * pmf[j]) < 5 * sigma, (j, got)
+
+    def test_merge_counts_beyond_2p24(self):
+        # Synthetic counts past the f32-exact boundary (VERDICT "bias test
+        # at counts > 2^24"): totals must be exact integers, the A-fraction
+        # must track c_a/total, and the merge must be deterministic.
+        R, k = 1024, 64
+        c_a_v, c_b_v = (1 << 26) + 1, (1 << 26) - 3
+        samples_a = jnp.zeros((R, k), jnp.int32)
+        samples_b = jnp.ones((R, k), jnp.int32)
+        c_a = jnp.full((R,), c_a_v, jnp.int32)
+        c_b = jnp.full((R,), c_b_v, jnp.int32)
+        s, c = al.merge_samples(samples_a, c_a, samples_b, c_b, jr.key(23))
+        assert np.all(np.asarray(c) == c_a_v + c_b_v)  # exact int total
+        p = c_a_v / (c_a_v + c_b_v)
+        n = R * k
+        took_a = int((np.asarray(s) == 0).sum())
+        sigma = math.sqrt(n * p * (1 - p))
+        assert abs(took_a - n * p) < 5 * sigma, took_a
+        s2, c2 = al.merge_samples(samples_a, c_a, samples_b, c_b, jr.key(23))
+        np.testing.assert_array_equal(np.asarray(s), np.asarray(s2))
+
+
 class TestPairwiseSummaryMerges:
     def test_distinct_merge_equals_joint_run(self):
         # bottom-k is a mergeable summary: merge(shard1, shard2) must be

@@ -114,10 +114,40 @@ def test_supports_gates():
     assert alp.supports(state, None, None, block_r=8)
     assert not alp.supports(state, jnp.ones((8,), jnp.int32), None, 8)  # ragged
     assert not alp.supports(state, None, lambda x: x, 8)  # map_fn
-    assert not alp.supports(state, None, None, block_r=3)  # R % block
     # dtype gates: mismatched batch dtype or unsupported sample dtype
     assert not alp.supports(state, None, None, 8, jnp.zeros((8, 4), jnp.float32))
     state64 = al.init(jr.key(5), 8, 4, sample_dtype=jnp.int8)
     assert not alp.supports(state64, None, None, 8)
-    with pytest.raises(ValueError):
-        alp.update_steady_pallas(state, jnp.zeros((8, 4), jnp.int32), block_r=3)
+    # WIDE (emulated-uint64) counters: XLA path
+    statew = al.init(jr.key(6), 8, 4, count_dtype=al.WIDE)
+    assert not alp.supports(statew, None, None, 8)
+
+
+def test_non_divisible_r_pads_and_matches_xla():
+    # any-R support (VERDICT r2 item 4): a partial last row-block rides as
+    # inert pad lanes; results are bit-identical to the XLA path
+    for R in (5, 13, 60):
+        k, B = 8, 64
+        state = al.init(jr.key(7), R, k)
+        state = al.update(state, jax.lax.broadcasted_iota(jnp.int32, (R, B), 1))
+        batch = 1000 + jax.lax.broadcasted_iota(jnp.int32, (R, B), 1)
+        ref = al.update_steady(state, batch)
+        got = alp.update_steady_pallas(state, batch, block_r=8, interpret=True)
+        np.testing.assert_array_equal(np.asarray(ref.samples), np.asarray(got.samples))
+        np.testing.assert_array_equal(np.asarray(ref.nxt), np.asarray(got.nxt))
+        np.testing.assert_array_equal(np.asarray(ref.count), np.asarray(got.count))
+        np.testing.assert_array_equal(np.asarray(ref.log_w), np.asarray(got.log_w))
+
+
+def test_auto_block_r_and_chunked_gather_match_xla():
+    # auto-sized blocks + the chunked one-hot gather (B > _GATHER_CHUNK_B
+    # exercises multiple chunks) stay bit-identical to XLA
+    R, k, B = 16, 8, 2048
+    assert B > alp._GATHER_CHUNK_B
+    state = al.init(jr.key(8), R, k)
+    state = al.update(state, jax.lax.broadcasted_iota(jnp.int32, (R, B), 1))
+    batch = 7777 + jax.lax.broadcasted_iota(jnp.int32, (R, B), 1)
+    ref = al.update_steady(state, batch)
+    got = alp.update_steady_pallas(state, batch, interpret=True)
+    np.testing.assert_array_equal(np.asarray(ref.samples), np.asarray(got.samples))
+    np.testing.assert_array_equal(np.asarray(ref.nxt), np.asarray(got.nxt))

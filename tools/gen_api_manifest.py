@@ -1,0 +1,133 @@
+"""Generate (or check) the public-API manifest — the MiMa analog.
+
+The reference CI gates binary compatibility with MiMa
+(``/root/reference/build.sbt:58-68``); the Python analog is a committed
+snapshot of the public surface: every ``__all__`` export of the public
+modules, with call signatures for callables and method lists for classes.
+``tests/test_public_api.py`` regenerates the snapshot and diffs it against
+``tests/public_api_manifest.json`` — any removal or signature change fails
+CI until the manifest is updated deliberately (the review-visible act that
+replaces a MiMa exclusion).
+
+Regenerate after an intentional API change:
+    python tools/gen_api_manifest.py --write
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib
+import inspect
+import json
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:  # script is runnable from anywhere
+    sys.path.insert(0, _REPO)
+
+# Introspection must never touch a real backend (the axon tunnel hangs when
+# down, and JAX_PLATFORMS is owned by the sitecustomize): pin CPU before
+# anything imports jax-adjacent modules.
+import jax  # noqa: E402
+
+try:
+    jax.config.update("jax_platforms", "cpu")
+except RuntimeError:  # backend already initialized by the embedding process
+    pass
+
+MANIFEST = os.path.join(_REPO, "tests", "public_api_manifest.json")
+
+#: The public import surface.  Additions here are API commitments.
+PUBLIC_MODULES = [
+    "reservoir_tpu",
+    "reservoir_tpu.api",
+    "reservoir_tpu.config",
+    "reservoir_tpu.engine",
+    "reservoir_tpu.errors",
+    "reservoir_tpu.ops.algorithm_l",
+    "reservoir_tpu.ops.algorithm_l_pallas",
+    "reservoir_tpu.ops.distinct",
+    "reservoir_tpu.ops.distinct_pallas",
+    "reservoir_tpu.ops.hashing",
+    "reservoir_tpu.ops.rng",
+    "reservoir_tpu.ops.threefry",
+    "reservoir_tpu.ops.u64e",
+    "reservoir_tpu.ops.weighted",
+    "reservoir_tpu.ops.weighted_pallas",
+    "reservoir_tpu.oracle",
+    "reservoir_tpu.parallel",
+    "reservoir_tpu.parallel.merge",
+    "reservoir_tpu.parallel.multihost",
+    "reservoir_tpu.parallel.sharded",
+    "reservoir_tpu.stream",
+    "reservoir_tpu.stream.bridge",
+    "reservoir_tpu.stream.interop",
+    "reservoir_tpu.stream.operator",
+    "reservoir_tpu.utils.checkpoint",
+    "reservoir_tpu.utils.metrics",
+    "reservoir_tpu.utils.selftest",
+    "reservoir_tpu.utils.tracing",
+]
+
+
+def _sig(obj) -> str:
+    try:
+        return str(inspect.signature(obj))
+    except (ValueError, TypeError):
+        return "<builtin>"
+
+
+def _describe(obj) -> object:
+    if inspect.isclass(obj):
+        methods = {}
+        for name, member in sorted(vars(obj).items()):
+            if name.startswith("_") and name not in ("__init__", "__call__"):
+                continue
+            if callable(member):
+                methods[name] = _sig(member)
+            elif isinstance(member, property):
+                methods[name] = "<property>"
+            elif isinstance(member, (staticmethod, classmethod)):
+                methods[name] = _sig(member.__func__)
+        return {"kind": "class", "methods": methods}
+    if callable(obj):
+        return {"kind": "function", "signature": _sig(obj)}
+    return {"kind": "value", "type": type(obj).__name__}
+
+
+def build_manifest() -> dict:
+    out = {}
+    for mod_name in PUBLIC_MODULES:
+        mod = importlib.import_module(mod_name)
+        exports = getattr(mod, "__all__", None)
+        if exports is None:
+            exports = [n for n in sorted(vars(mod)) if not n.startswith("_")]
+        out[mod_name] = {
+            name: _describe(getattr(mod, name)) for name in sorted(exports)
+        }
+    return out
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--write", action="store_true")
+    args = ap.parse_args()
+    manifest = build_manifest()
+    if args.write:
+        with open(MANIFEST, "w") as f:
+            json.dump(manifest, f, indent=1, sort_keys=True)
+            f.write("\n")
+        print(f"wrote {MANIFEST}")
+        return 0
+    with open(MANIFEST) as f:
+        committed = json.load(f)
+    if committed == manifest:
+        print("public API matches the manifest")
+        return 0
+    print("PUBLIC API DRIFT (run tools/gen_api_manifest.py --write if intended)")
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

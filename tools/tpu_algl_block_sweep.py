@@ -1,0 +1,105 @@
+"""Algorithm-L Pallas block sweep on the live TPU (VERDICT r2 item 4).
+
+Round 2 found block_r > 64 blew up Mosaic compile (>6 min, killed); the
+kernel has since been restructured (chunked one-hot gathers).  This script
+measures, per block size, compile wall time and steady-state throughput —
+each in a THROWAWAY subprocess with a hard timeout, so a compile blowup
+costs its timeout and is recorded, never inherited.  Appends JSON lines to
+``TPU_BLOCK_SWEEP.jsonl``.
+
+Usage (only sensible against a live TPU backend):
+    python tools/tpu_algl_block_sweep.py [--blocks 64,128,256] [--timeout 420]
+"""
+
+from __future__ import annotations
+
+import argparse
+import datetime
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+OUT = os.path.join(REPO, "TPU_BLOCK_SWEEP.jsonl")
+
+_CHILD = r"""
+import json, sys, time
+import jax, jax.numpy as jnp, jax.random as jr
+import functools
+block_r = int(sys.argv[1])
+R, k, B, steps = 65536, 128, 2048, 50
+from reservoir_tpu.ops import algorithm_l as al
+from reservoir_tpu.ops import algorithm_l_pallas as alp
+state = al.init(jr.key(0), R, k)
+state = al.update(state, jax.lax.broadcasted_iota(jnp.int32, (R, B), 1))
+step_fn = functools.partial(alp.update_steady_pallas, block_r=block_r)
+
+@functools.partial(jax.jit, donate_argnums=0)
+def run(state, step0):
+    def body(state, s):
+        base = ((step0 + s) * B).astype(jnp.int32)
+        batch = base + jax.lax.broadcasted_iota(jnp.int32, (R, B), 1)
+        return step_fn(state, batch), None
+    state, _ = jax.lax.scan(body, state, jnp.arange(steps, dtype=jnp.int32))
+    return state
+
+import numpy as np
+t0 = time.perf_counter()
+state = run(state, jnp.asarray(0, jnp.int32))
+int(np.asarray(jax.device_get(jax.tree.leaves(state)[0].ravel()[0])))
+compile_s = time.perf_counter() - t0
+times = []
+for r in (1, 2):
+    t0 = time.perf_counter()
+    state = run(state, jnp.asarray(r * steps, jnp.int32))
+    int(np.asarray(jax.device_get(jax.tree.leaves(state)[0].ravel()[0])))
+    times.append(time.perf_counter() - t0)
+print(json.dumps({
+    "block_r": block_r,
+    "compile_plus_first_run_s": round(compile_s, 2),
+    "elem_per_sec": R * B * steps / min(times),
+}))
+"""
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--blocks", default="64,128,256")
+    ap.add_argument("--timeout", type=float, default=420.0)
+    args = ap.parse_args()
+    for blk in args.blocks.split(","):
+        t0 = time.time()
+        rec = {
+            "ts": datetime.datetime.now(datetime.timezone.utc).isoformat(),
+            "block_r": int(blk),
+        }
+        try:
+            proc = subprocess.run(
+                [sys.executable, "-c", _CHILD, blk],
+                capture_output=True,
+                timeout=args.timeout,
+                text=True,
+                cwd=REPO,
+            )
+            rec["wall_s"] = round(time.time() - t0, 1)
+            if proc.returncode == 0:
+                for line in reversed(proc.stdout.splitlines()):
+                    if line.startswith("{"):
+                        rec["result"] = json.loads(line)
+                        break
+            else:
+                rec["rc"] = proc.returncode
+                rec["stderr_tail"] = proc.stderr[-1500:]
+        except subprocess.TimeoutExpired:
+            rec["rc"] = "timeout"
+            rec["wall_s"] = round(time.time() - t0, 1)
+        with open(OUT, "a") as f:
+            f.write(json.dumps(rec) + "\n")
+        print(rec, flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -45,11 +45,12 @@ def probe() -> str | None:
     probe (new tunnel failure mode) can't leave the watcher declaring UP a
     backend bench.py then can't use.
     """
-    sys.path.insert(0, REPO)
-    try:
-        from bench import _probe_backend_proc
-    finally:
-        sys.path.pop(0)
+    if REPO not in sys.path:
+        # stays on the path: bench's probe helper lazily imports
+        # reservoir_tpu at CALL time, not import time
+        sys.path.insert(0, REPO)
+    from bench import _probe_backend_proc
+
     return _probe_backend_proc(PROBE_TIMEOUT)
 
 
@@ -76,18 +77,33 @@ def capture_bench(config: str, timeout_s: float = BENCH_TIMEOUT) -> str:
             env=env,
             cwd=REPO,
         )
-    except subprocess.TimeoutExpired:
+    except subprocess.TimeoutExpired as e:
+        # salvage any JSON line already printed: the bench prints its
+        # number before/without the selftest completing in some paths — a
+        # hang later in the run must not erase a captured measurement
+        salvaged = None
+        out = e.stdout or b""
+        if isinstance(out, bytes):
+            out = out.decode(errors="replace")
+        for line in out.splitlines():
+            line = line.strip()
+            if line.startswith("{"):
+                try:
+                    salvaged = json.loads(line)
+                except json.JSONDecodeError:
+                    pass
         _append(
             {
                 "ts": _now(),
                 "config": config,
                 "rc": "timeout",
                 "wall_s": round(time.time() - t0, 1),
+                "result": salvaged,
             }
         )
         # a healthy bench cannot hang past its own probe guard — a
         # timeout means the tunnel dropped mid-run; stop burning the window
-        return "unreachable"
+        return "ok" if salvaged else "unreachable"
     parsed = None
     for line in proc.stdout.splitlines():
         line = line.strip()
