@@ -113,20 +113,10 @@ class ReservoirEngine:
                     "impl='pallas' requires the default hash (the kernel "
                     "owns the value-bits embedding); use impl='auto'"
                 )
-            if config.distinct or config.weighted:
-                # the Algorithm-L kernel pads partial row-blocks with inert
-                # lanes (any R); the distinct/weighted kernels still require
-                # block divisibility
-                block_r = self._pallas_module()._DEFAULT_BLOCK_R
-                if config.num_reservoirs % block_r != 0:
-                    raise ValueError(
-                        "impl='pallas' requires num_reservoirs divisible by "
-                        f"{block_r}, got {config.num_reservoirs}"
-                    )
-            # mesh_axis is fine: the kernel is collective-free over the
-            # reservoir grid, so it runs under shard_map with each chip
-            # taking its row-blocks; per-shard divisibility is checked after
-            # the mesh is built below
+            # No R-divisibility requirement: every kernel pads a partial
+            # last row-block with inert lanes.  mesh_axis is fine too: the
+            # kernels are collective-free over the reservoir grid, so they
+            # run under shard_map with each chip padding its own shard.
         # Multi-chip placement (SamplerConfig.mesh_axis makes the mesh real,
         # VERDICT r1 item 4): state shards over the reservoir axis and every
         # incoming tile is device_put with the matching sharding, so the
@@ -147,15 +137,6 @@ class ReservoirEngine:
                     f"evenly over the {n_shards}-device '{config.mesh_axis}' "
                     "mesh axis"
                 )
-            if config.impl == "pallas" and (config.distinct or config.weighted):
-                block_r = self._pallas_module()._DEFAULT_BLOCK_R
-                if (config.num_reservoirs // n_shards) % block_r != 0:
-                    raise ValueError(
-                        "impl='pallas' on this mesh needs "
-                        f"num_reservoirs/{n_shards} divisible by "
-                        f"{block_r}, got "
-                        f"{config.num_reservoirs // n_shards}"
-                    )
             self._tile_sharding = jax.sharding.NamedSharding(
                 self._mesh, jax.sharding.PartitionSpec(config.mesh_axis, None)
             )
@@ -295,15 +276,6 @@ class ReservoirEngine:
                 return False
         elif jnp.dtype(tile_dtype) != self._state.samples.dtype:
             return False
-        if self._mesh is not None and self._ops is not _algl:
-            # under shard_map each chip runs the kernel on its own
-            # row-blocks; distinct/weighted still require the per-shard
-            # reservoir count to tile (the Algorithm-L kernel pads)
-            n_shards = self._mesh.shape[self._config.mesh_axis]
-            if (
-                self._config.num_reservoirs // n_shards
-            ) % mod._DEFAULT_BLOCK_R != 0:
-                return False
         if self._config.impl == "pallas":
             return True
         # auto: Mosaic lowers on TPU only — GPU/CPU backends take the XLA

@@ -190,8 +190,9 @@ def update_steady_pallas(
         block_r = pick_block_r(R, k, B)
     R_orig = R
     if R % block_r != 0:
-        if R < block_r:
-            block_r = 1 << max(0, (R.bit_length() - 1))  # pow2 <= R
+        from .blocking import shrink_block_to
+
+        block_r = shrink_block_to(R, block_r)
         pad = (-R) % block_r
         if pad:
             # inert pad lanes: count 0, nxt = B + 1 > end, so cond() is

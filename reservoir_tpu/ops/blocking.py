@@ -12,7 +12,28 @@ budget, from a caller-supplied bytes-per-row estimate.
 
 from __future__ import annotations
 
-__all__ = ["pick_block_r"]
+__all__ = ["pick_block_r", "pad_rows", "shrink_block_to"]
+
+
+def shrink_block_to(num_reservoirs: int, block_r: int) -> int:
+    """Largest power of two <= R when R is smaller than the block."""
+    if num_reservoirs >= block_r:
+        return block_r
+    return 1 << max(0, num_reservoirs.bit_length() - 1)
+
+
+def pad_rows(pad: int, *arrays):
+    """Pad the leading (reservoir) axis of each array by replicating its
+    last row ``pad`` times — the any-R grid trick: pad lanes carry a valid
+    (copied) state, compute in lockstep with their block, and are sliced
+    off after the kernel.  Callers make pad lanes *inert* where it matters
+    (zero weights, ``nxt`` past the tile) so they also do no wasted work.
+    """
+    import jax.numpy as jnp
+
+    return tuple(
+        jnp.concatenate([a, jnp.repeat(a[-1:], pad, axis=0)]) for a in arrays
+    )
 
 _MAX_BLOCK_R = 128
 # half of v5e's ~16 MiB VMEM, leaving the rest for Mosaic's own temporaries

@@ -30,7 +30,8 @@ dispatch equivalence tests.
 
 Scope (engine dispatch via :func:`supports`): full tiles, identity
 ``map_fn``/default hash, int32 counters, narrow (4-byte) or wide (8-byte
-bit-plane) keys, R divisible by the row-block size.
+bit-plane) keys.  Any R: a partial last row-block pads with replicated
+inert lanes and is sliced off after the kernel.
 """
 
 from __future__ import annotations
@@ -73,13 +74,12 @@ def supports(
     block_r=None,
     batch=None,
 ) -> bool:
-    """True iff this kernel can take the tile (else: XLA path)."""
-    need = _DEFAULT_BLOCK_R if block_r is None else block_r
+    """True iff this kernel can take the tile (else: XLA path).  Any R —
+    a partial last row-block pads with replicated inert lanes."""
     return (
         valid is None
         and map_fn is None
         and state.count.dtype == jnp.int32
-        and state.values.shape[0] % need == 0
     )
 
 
@@ -279,8 +279,7 @@ def update_pallas(
     if not supports(state, None, None, block_r, batch):
         raise ValueError(
             "update_pallas: unsupported config (need int32 counters, "
-            f"R % {block_r or _DEFAULT_BLOCK_R} == 0, full tiles); "
-            "use ops.distinct.update"
+            "full tiles); use ops.distinct.update"
         )
     if wide:
         bvhi, bvlo = batch
@@ -301,6 +300,24 @@ def update_pallas(
         block_r = pick_block_r(R, k, B)
     if bvlo.shape[0] != R:
         raise ValueError(f"batch has {bvlo.shape[0]} rows for {R} reservoirs")
+    hash_hi, hash_lo = state.hash_hi, state.hash_lo
+    size, salts = state.size, state.salts
+    R_orig = R
+    if R % block_r != 0:
+        from .blocking import pad_rows, shrink_block_to
+
+        block_r = shrink_block_to(R, block_r)
+        pad = (-R) % block_r
+        if pad:
+            # pad lanes replicate the last reservoir and insert into their
+            # own (discarded) copies — sliced off after the kernel
+            (cvalues, cvhi, hash_hi, hash_lo, size, salts, bvlo, bvhi) = (
+                pad_rows(
+                    pad, cvalues, cvhi, hash_hi, hash_lo, size, salts,
+                    bvlo, bvhi,
+                )
+            )
+            R += pad
 
     col = lambda i: (i, 0)  # noqa: E731 — row-block i, full second axis
     col_spec = lambda w: pl.BlockSpec(  # noqa: E731
@@ -338,18 +355,24 @@ def update_pallas(
     )(
         cvalues,
         cvhi,
-        state.hash_hi,
-        state.hash_lo,
-        state.size.reshape(R, 1),
-        state.salts,
+        hash_hi,
+        hash_lo,
+        size.reshape(R, 1),
+        salts,
         bvlo,
         bvhi,
     )
+    if R != R_orig:  # drop the inert pad lanes
+        out_values = out_values[:R_orig]
+        out_vhi = out_vhi[:R_orig]
+        out_hhi = out_hhi[:R_orig]
+        out_hlo = out_hlo[:R_orig]
+        out_size = out_size[:R_orig]
     return DistinctState(
         values=out_values,
         hash_hi=out_hhi,
         hash_lo=out_hlo,
-        size=out_size.reshape(R),
+        size=out_size.reshape(R_orig),
         count=state.count + jnp.asarray(B, state.count.dtype),
         salts=state.salts,
         value_hi=out_vhi if wide else None,

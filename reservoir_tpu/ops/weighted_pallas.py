@@ -21,7 +21,8 @@ interpret mode by ``tests/test_pallas_weighted.py``.
 
 Scope (engine dispatch via :func:`supports`): full tiles (no ``valid``),
 identity ``map_fn``, int32 counters, int32/float32/uint32 samples, float32
-weights, R divisible by the row-block size.
+weights.  Any R: a partial last row-block pads with zero-weight inert
+lanes and is sliced off after the kernel.
 """
 
 from __future__ import annotations
@@ -64,15 +65,14 @@ def supports(
     block_r=None,
     batch: "jax.Array | None" = None,
 ) -> bool:
-    """True iff this kernel can take the tile (else: XLA path)."""
-    need = _DEFAULT_BLOCK_R if block_r is None else block_r
+    """True iff this kernel can take the tile (else: XLA path).  Any R —
+    a partial last row-block pads with zero-weight inert lanes."""
     return (
         valid is None
         and map_fn is None
         and state.count.dtype == jnp.int32
         and state.samples.dtype in (jnp.int32, jnp.float32, jnp.uint32)
         and (batch is None or batch.dtype == state.samples.dtype)
-        and state.samples.shape[0] % need == 0
     )
 
 
@@ -274,12 +274,29 @@ def update_pallas(
     if not supports(state, None, None, block_r, elems):
         raise ValueError(
             "update_pallas: unsupported config (need int32 counters, "
-            f"int32/float32/uint32 samples, elems dtype == samples dtype, "
-            f"R % {block_r or _DEFAULT_BLOCK_R} == 0); "
+            f"int32/float32/uint32 samples, elems dtype == samples dtype); "
             "use ops.weighted.update"
         )
     if block_r is None:
         block_r = pick_block_r(R, k, B)
+    R_orig = R
+    if R % block_r != 0:
+        from .blocking import pad_rows, shrink_block_to
+
+        block_r = shrink_block_to(R, block_r)
+        pad = (-R) % block_r
+        if pad:
+            # pad lanes replicate the last reservoir but see ZERO weights:
+            # A-ExpJ never accepts weight-0 elements, so they are inert
+            state = WeightedState(
+                *pad_rows(pad, *state)
+            )
+            (elems,) = pad_rows(pad, elems)
+            weights = jnp.concatenate(
+                [jnp.asarray(weights, jnp.float32),
+                 jnp.zeros((pad, B), jnp.float32)]
+            )
+            R += pad
     kd1, kd2 = key_words(state.key)  # [R] uint32 each
     key_data = jnp.stack([kd1, kd2], axis=1)  # [R, 2]
 
@@ -316,10 +333,15 @@ def update_pallas(
         elems,
         jnp.asarray(weights, jnp.float32),
     )
+    if R != R_orig:  # drop the inert pad lanes
+        out_samples = out_samples[:R_orig]
+        out_lkeys = out_lkeys[:R_orig]
+        out_xw = out_xw[:R_orig]
+        state = jax.tree.map(lambda x: x[:R_orig], state)
     return WeightedState(
         samples=out_samples,
         lkeys=out_lkeys,
         count=state.count + jnp.asarray(B, state.count.dtype),
-        xw=out_xw.reshape(R),
+        xw=out_xw.reshape(R_orig),
         key=state.key,
     )

@@ -141,16 +141,13 @@ class TestPallasDispatch:
         assert not e.pallas_used()
 
     def test_forced_pallas_rejects_ineligible_configs(self):
-        # duplicates mode accepts ANY R now (the kernel pads partial
-        # row-blocks); distinct/weighted still require block divisibility
-        ReservoirEngine(
-            SamplerConfig(max_sample_size=8, num_reservoirs=60, impl="pallas")
-        )
-        with pytest.raises(ValueError, match="divisible"):
+        # every kernel accepts ANY R now (partial row-blocks pad with
+        # inert lanes) — constructors must succeed at awkward R
+        for mode in ({}, {"weighted": True}, {"distinct": True}):
             ReservoirEngine(
                 SamplerConfig(
-                    max_sample_size=8, num_reservoirs=60,
-                    weighted=True, impl="pallas",
+                    max_sample_size=8, num_reservoirs=60, impl="pallas",
+                    **mode,
                 )
             )
         with pytest.raises(ValueError, match="default hash"):

@@ -170,22 +170,11 @@ def test_engine_sharded_pallas_bit_identical():
     np.testing.assert_array_equal(z0, z2)
 
 
-def test_engine_sharded_pallas_rejects_untileable_shard():
-    # 8 devices x block 64: R=256 gives 32 reservoirs/shard.  Duplicates
-    # mode now PADS partial row-blocks (any R); the weighted kernel still
-    # requires per-shard divisibility — constructor must fail fast
-    # (Sampler.scala:79-95 validation philosophy)
-    ReservoirEngine(
-        SamplerConfig(
-            max_sample_size=8,
-            num_reservoirs=256,
-            tile_size=32,
-            impl="pallas",
-            mesh_axis="res",
-        ),
-        key=1,
-    )
-    with pytest.raises(ValueError, match="divisible"):
+def test_engine_sharded_pallas_accepts_untileable_shard():
+    # 8 devices x block 64: R=256 gives 32 reservoirs/shard — every
+    # kernel now pads partial row-blocks per shard, so construction
+    # succeeds for all modes
+    for mode in ({}, {"weighted": True}, {"distinct": True}):
         ReservoirEngine(
             SamplerConfig(
                 max_sample_size=8,
@@ -193,7 +182,7 @@ def test_engine_sharded_pallas_rejects_untileable_shard():
                 tile_size=32,
                 impl="pallas",
                 mesh_axis="res",
-                weighted=True,
+                **mode,
             ),
             key=1,
         )

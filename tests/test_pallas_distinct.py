@@ -87,10 +87,28 @@ def test_distinct_pallas_underfill_then_steady():
 
 def test_distinct_pallas_rejects_unsupported():
     state = dd.init(jr.key(13), 6, 4)  # R=6 not divisible by block_r
-    with pytest.raises(ValueError, match="unsupported"):
-        dp.update_pallas(
-            state, jnp.zeros((6, 8), jnp.int32), block_r=8, interpret=True
-        )
+    # ragged tiles still take the XLA path
+    assert not dp.supports(state, jnp.ones((6,), jnp.int32), None, 8)
+
+
+def test_distinct_pallas_any_r_pads_and_matches_xla():
+    # any-R support: partial last row-blocks pad with replicated inert
+    # lanes; results stay state-identical to XLA
+    for R in (6, 13, 60):
+        k, B = 8, 64
+        s_ref = s_pal = dd.init(jr.key(30), R, k)
+        for step in range(2):
+            batch = jr.randint(
+                jr.fold_in(jr.key(31), step), (R, B), 0, 300, jnp.int32
+            )
+            s_ref = dd.update(s_ref, batch)
+            s_pal = dp.update_pallas(s_pal, batch, block_r=8, interpret=True)
+            np.testing.assert_array_equal(
+                np.asarray(s_ref.values), np.asarray(s_pal.values)
+            )
+            np.testing.assert_array_equal(
+                np.asarray(s_ref.size), np.asarray(s_pal.size)
+            )
 
 
 def test_pick_block_r():

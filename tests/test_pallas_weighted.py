@@ -84,11 +84,25 @@ def test_weighted_pallas_float_weights_close():
 
 
 def test_weighted_pallas_rejects_unsupported():
-    state = ww.init(jr.key(9), 6, 4)  # R=6 not divisible by block_r
-    elems = jnp.zeros((6, 8), jnp.int32)
-    weights = jnp.ones((6, 8), jnp.float32)
-    with pytest.raises(ValueError, match="unsupported"):
-        wp.update_pallas(state, elems, weights, block_r=8, interpret=True)
+    # ragged tiles still take the XLA path
+    state = ww.init(jr.key(9), 8, 4)
+    assert not wp.supports(state, jnp.ones((8,), jnp.int32), None, 8)
+
+
+def test_weighted_pallas_any_r_pads_and_matches_xla():
+    # any-R support: partial last row-blocks pad with zero-weight inert
+    # lanes; results stay bit-identical to XLA
+    for R in (6, 13, 60):
+        k, B = 4, 64
+        state = ww.init(jr.key(20), R, k)
+        elems = jax.lax.broadcasted_iota(jnp.int32, (R, B), 1)
+        weights = 0.5 + jr.uniform(jr.key(21), (R, B))
+        ref = ww.update(state, elems, weights)
+        got = wp.update_pallas(state, elems, weights, block_r=8, interpret=True)
+        np.testing.assert_array_equal(np.asarray(ref.samples), np.asarray(got.samples))
+        np.testing.assert_array_equal(np.asarray(ref.lkeys), np.asarray(got.lkeys))
+        np.testing.assert_array_equal(np.asarray(ref.count), np.asarray(got.count))
+        np.testing.assert_array_equal(np.asarray(ref.xw), np.asarray(got.xw))
 
 
 def test_pick_block_r():
