@@ -224,12 +224,21 @@ def _bench_bridge(S, k, B, steps, reps):
         _readback_barrier(bridge._engine._state.count)
 
     one_pass()  # warm: compiles every flush shape
+    # reset the stage decomposition so the table covers only timed reps
+    # (VERDICT r3 item 5: demux/drain/dispatch rates next to the
+    # end-to-end number tell which host stage dominates)
+    m = bridge.metrics
+    m.demux_s = m.drain_s = m.dispatch_s = 0.0
+    m.elements = m.flushed_elements = m.flushes = 0
     times = []
     for _ in range(reps):
         t0 = time.perf_counter()
         one_pass()
         times.append(time.perf_counter() - t0)
-    return times
+    stages = dict(m.snapshot()["stages"])
+    stages["zero_copy"] = bridge._zero_copy
+    stages["pipelined"] = pipelined
+    return times, stages
 
 
 def _bench_transfer(S, k, B, steps, reps):
@@ -510,7 +519,7 @@ def main() -> None:
             times = _bench_transfer(R, k, B, steps, reps)
             tag = "raw_transfer"
         else:
-            times = _bench_bridge(R, k, B, steps, reps)
+            times, bridge_stages = _bench_bridge(R, k, B, steps, reps)
             tag = "bridge_host_feed"
     n_elems = R * B * steps
     value = n_elems / min(times)
@@ -524,6 +533,8 @@ def main() -> None:
         "reps": reps,
         "platform": platform,
     }
+    if config == "bridge":
+        record["stages"] = bridge_stages
     if (
         platform == "tpu"
         and os.environ.get("RESERVOIR_BENCH_SELFTEST", "1") == "1"

@@ -17,9 +17,9 @@
  * downstream cancel delivers the partial sample, cancel-with-cause and
  * abrupt stop fail it.
  *
- * NOTE: this example ships as source; the build image for the Python
- * framework has no JVM, so it is compiled/tested against a real Akka
- * setup, not in this repo's CI.  sbt deps: akka-stream 2.6.x.
+ * Compiled and exercised on a real ActorSystem by the `jvm-interop` CI
+ * job (build.sbt + TpuSampleCheck.scala in this directory) against a
+ * live SampleServer.  sbt deps: akka-stream 2.6.x.
  */
 package reservoir.tpu.interop
 

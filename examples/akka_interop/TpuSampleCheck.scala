@@ -1,0 +1,85 @@
+/* CI check: run REAL Akka graphs through the TpuSample shim against a
+ * live reservoir_tpu SampleServer (started by the `jvm-interop` CI job).
+ *
+ * This is the JVM-side counterpart of tests/test_interop.py and the
+ * analog of the reference's live-ActorSystem stage test
+ * (akka-stream/.../SampleTest.scala:23-47): it demonstrates the
+ * "existing Akka flows run unchanged" clause as fact, not example
+ * source.  Scenarios: pass-through integrity, sampled-result shape,
+ * underfull in-order delivery, distinct dedup, and upstream-failure
+ * propagation.
+ */
+package reservoir.tpu.interop
+
+import akka.actor.ActorSystem
+import akka.stream.scaladsl._
+import scala.concurrent.Await
+import scala.concurrent.duration._
+import scala.util.{Failure, Success, Try}
+
+object TpuSampleCheck {
+  def main(args: Array[String]): Unit = {
+    val host = sys.env.getOrElse("SAMPLE_SERVER_HOST", "127.0.0.1")
+    val port = sys.env.getOrElse("SAMPLE_SERVER_PORT", "7676").toInt
+    implicit val system: ActorSystem = ActorSystem("tpu-sample-check")
+    try {
+      // 1. uniform sample over a 100k stream: the stage is pass-through
+      // (every element reaches downstream exactly once) and the
+      // materialized future holds k elements drawn from the stream
+      val n = 100000L
+      val k = 64
+      val (sampleF, sumF) = Source(1L to n)
+        .viaMat(TpuSample(k, host, port))(Keep.right)
+        .toMat(Sink.fold(0L)(_ + _))(Keep.both)
+        .run()
+      val sum = Await.result(sumF, 120.seconds)
+      require(sum == n * (n + 1) / 2, s"pass-through corrupted: sum=$sum")
+      val sample = Await.result(sampleF, 120.seconds)
+      require(sample.size == k, s"expected $k sampled, got ${sample.size}")
+      require(
+        sample.forall(e => e >= 1L && e <= n),
+        s"sampled element outside the stream: $sample"
+      )
+
+      // 2. underfull stream: shorter than k delivers every element in
+      // stream order (the reference's whole-stream contract)
+      val short = Await.result(
+        Source(1L to 10L)
+          .viaMat(TpuSample(k, host, port))(Keep.right)
+          .to(Sink.ignore)
+          .run(),
+        120.seconds
+      )
+      require(short == (1L to 10L).toVector, s"underfull mismatch: $short")
+
+      // 3. distinct mode: duplicates collapse; k >= #unique returns the
+      // unique value set
+      val distinctF = Source((1L to 50L) ++ (1L to 50L))
+        .viaMat(TpuSample.distinct(k, host, port))(Keep.right)
+        .to(Sink.ignore)
+        .run()
+      val uniq = Await.result(distinctF, 120.seconds)
+      require(
+        uniq.toSet == (1L to 50L).toSet,
+        s"distinct mismatch: ${uniq.sorted}"
+      )
+
+      // 4. upstream failure fails the materialized future (the server
+      // discards the partial sample via the F frame)
+      val failedF = Source(1L to 100L)
+        .concat(Source.failed[Long](new RuntimeException("boom")))
+        .viaMat(TpuSample(k, host, port))(Keep.right)
+        .to(Sink.ignore)
+        .run()
+      Try(Await.result(failedF, 120.seconds)) match {
+        case Failure(_) => () // expected
+        case Success(v) =>
+          require(false, s"future should have failed, got $v")
+      }
+
+      println("ALL INTEROP CHECKS PASSED")
+    } finally {
+      Await.result(system.terminate(), 30.seconds)
+    }
+  }
+}

@@ -1,0 +1,13 @@
+// sbt build for the Akka interop shim + its CI check.  Compiled and run
+// by the `jvm-interop` CI job (GitHub runners have a JVM; the Python dev
+// image does not) against a live SampleServer — the "existing Akka flows
+// run unchanged" demonstration (reference SampleTest.scala:23-47 analog).
+name := "tpu-sample-interop"
+
+scalaVersion := "2.13.14"
+
+// sources live flat in this directory (TpuSample.scala is also read as
+// example code by humans; keep it at the top level)
+Compile / scalaSource := baseDirectory.value
+
+libraryDependencies += "com.typesafe.akka" %% "akka-stream" % "2.6.20"

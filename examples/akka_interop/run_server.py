@@ -1,0 +1,27 @@
+"""Start a SampleServer for the JVM interop CI job and serve until killed.
+
+Usage: python run_server.py [port]   (default 7676; prints "READY <port>"
+once the socket is listening, which the CI job waits on).
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+from reservoir_tpu.stream.interop import SampleServer
+
+
+def main() -> None:
+    port = int(sys.argv[1]) if len(sys.argv) > 1 else 7676
+    srv = SampleServer(port=port).start()
+    print(f"READY {srv.address[1]}", flush=True)
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        srv.close()
+
+
+if __name__ == "__main__":
+    main()

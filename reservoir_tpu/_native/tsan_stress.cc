@@ -29,6 +29,8 @@ int64_t rsv_staging_push_interleaved(void*, const int32_t*, const void*,
 int32_t rsv_staging_fill(void*, int32_t);
 int32_t rsv_staging_any_full(void*);
 int64_t rsv_staging_drain(void*, void*, void*, int32_t*);
+int32_t rsv_staging_attach(void*, void*, void*);
+int64_t rsv_staging_take(void*, int32_t*);
 }
 
 namespace {
@@ -96,6 +98,114 @@ void monitor(void* sb) {
 
 }  // namespace
 
+// Phase 2: the zero-copy (attach/take) handoff contract — ONE producer
+// demuxes into the attached tile and swaps buffers at each "flush"
+// (take + attach-other), while a reader thread scans the tile the
+// producer just handed off.  Mirrors the bridge's depth-1 pipeline: the
+// producer never re-attaches a tile before the reader signalled done
+// (the semaphore role is played by an atomic generation counter).
+namespace {
+
+std::atomic<int64_t> zc_handed{0};   // generation handed to the reader
+std::atomic<int64_t> zc_read{0};     // generation the reader finished
+std::atomic<int64_t> zc_sum_w{0};    // element checksum written
+std::atomic<int64_t> zc_sum_r{0};    // element checksum read
+constexpr int kZcFlushes = 200;
+
+void zc_producer(void* sb, std::vector<int32_t>* tiles,
+                 std::vector<int32_t>* valids) {
+  unsigned state = 7u;
+  std::vector<int32_t> streams(kStreams * kWidth / 2);
+  std::vector<int32_t> elems(streams.size());
+  int active = 0;
+  for (int flush = 0; flush < kZcFlushes; ++flush) {
+    const int64_t n = static_cast<int64_t>(streams.size());
+    for (int64_t i = 0; i < n; ++i) {
+      state = state * 1664525u + 1013904223u;
+      streams[i] = static_cast<int32_t>(state % kStreams);
+      elems[i] = static_cast<int32_t>(state >> 8) & 0xffff;
+    }
+    int64_t off = 0;
+    while (off < n) {
+      int64_t took = rsv_staging_push_interleaved(
+          sb, streams.data() + off, elems.data() + off, nullptr, n - off);
+      if (took < 0) std::abort();
+      for (int64_t i = off; i < off + took; ++i) zc_sum_w.fetch_add(elems[i]);
+      off += took;
+      if (off < n) {
+        // row full mid-batch: flush (take + swap) exactly like the bridge
+        int64_t total =
+            rsv_staging_take(sb, valids[active].data());
+        if (total < 0) std::abort();
+        // wait until the reader is done with the OTHER tile (depth-1)
+        while (zc_handed.load() - zc_read.load() >= 1)
+          std::this_thread::yield();
+        zc_handed.fetch_add(1);
+        int next = 1 - active;
+        if (rsv_staging_attach(sb, tiles[next].data(), nullptr) != 0)
+          std::abort();
+        active = next;
+      }
+    }
+  }
+  // final flush of the remainder
+  int64_t total = rsv_staging_take(sb, valids[active].data());
+  if (total < 0) std::abort();
+  while (zc_handed.load() - zc_read.load() >= 1) std::this_thread::yield();
+  zc_handed.fetch_add(1);
+}
+
+void zc_reader(std::vector<int32_t>* tiles, std::vector<int32_t>* valids,
+               std::atomic<bool>* done) {
+  int active = 0;
+  while (true) {
+    if (zc_read.load() == zc_handed.load()) {
+      if (done->load() && zc_read.load() == zc_handed.load()) break;
+      std::this_thread::yield();
+      continue;
+    }
+    // the tile at `active` was handed off; sum its valid elements
+    for (int32_t s = 0; s < kStreams; ++s) {
+      const int32_t f = valids[active][s];
+      for (int32_t j = 0; j < f; ++j) {
+        zc_sum_r.fetch_add(tiles[active][static_cast<size_t>(s) * kWidth + j]);
+      }
+    }
+    zc_read.fetch_add(1);
+    active = 1 - active;
+  }
+}
+
+}  // namespace
+
+static int run_zero_copy_phase() {
+  void* sb = rsv_staging_create(kStreams, kWidth, sizeof(int32_t), 1);
+  if (!sb) return 1;
+  std::vector<int32_t> tiles[2] = {
+      std::vector<int32_t>(static_cast<size_t>(kStreams) * kWidth),
+      std::vector<int32_t>(static_cast<size_t>(kStreams) * kWidth)};
+  std::vector<int32_t> valids[2] = {std::vector<int32_t>(kStreams),
+                                    std::vector<int32_t>(kStreams)};
+  if (rsv_staging_attach(sb, tiles[0].data(), nullptr) != 0) return 1;
+  std::atomic<bool> done{false};
+  std::thread r(zc_reader, tiles, valids, &done);
+  std::thread p(zc_producer, sb, tiles, valids);
+  p.join();
+  done.store(true);
+  r.join();
+  rsv_staging_destroy(sb);
+  if (zc_sum_w.load() != zc_sum_r.load()) {
+    std::fprintf(stderr, "zero-copy checksum mismatch: wrote=%lld read=%lld\n",
+                 static_cast<long long>(zc_sum_w.load()),
+                 static_cast<long long>(zc_sum_r.load()));
+    return 1;
+  }
+  std::printf("tsan_stress zero-copy OK: %lld handoffs, checksum %lld\n",
+              static_cast<long long>(zc_read.load()),
+              static_cast<long long>(zc_sum_r.load()));
+  return 0;
+}
+
 int main() {
   void* sb = rsv_staging_create(kStreams, kWidth, sizeof(int32_t), 1);
   if (!sb) {
@@ -122,5 +232,5 @@ int main() {
   rsv_staging_destroy(sb);
   std::printf("tsan_stress OK: %lld elements through %d streams\n",
               static_cast<long long>(expect), kStreams);
-  return 0;
+  return run_zero_copy_phase();
 }

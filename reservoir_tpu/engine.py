@@ -97,9 +97,9 @@ class ReservoirEngine:
         if config.impl == "pallas":
             # Fail construction, not first sample, if this config can never
             # reach a kernel (the "fail fast" validation philosophy of
-            # ``Sampler.scala:79-95``).  Duplicates mode: the Algorithm-L
-            # kernel is steady-state-only (fill/ragged tiles use XLA);
-            # weighted and distinct kernels take every full tile.
+            # ``Sampler.scala:79-95``).  All three kernels are
+            # fill-capable and take every full tile; ragged tiles use XLA
+            # (logged once per engine at first fallback).
             if map_fn is not None:
                 raise ValueError("impl='pallas' requires an identity map_fn")
             if config.count_dtype == "wide":
@@ -121,6 +121,7 @@ class ReservoirEngine:
         # VERDICT r1 item 4): state shards over the reservoir axis and every
         # incoming tile is device_put with the matching sharding, so the
         # cached jitted updates compile to collective-free SPMD programs.
+        self._pallas_fallback_logged = False
         self._mesh = None
         self._tile_sharding = None
         self._row_sharding = None
@@ -252,18 +253,38 @@ class ReservoirEngine:
     def _pallas_eligible(self, steady: bool, ragged: bool, tile_dtype) -> bool:
         """Dispatch gate for the Pallas kernels (VERDICT r1 item 2): the
         hot path goes through Mosaic when the kernel's ``supports()``
-        contract holds; everything else falls back to XLA.  Duplicates mode
-        requires steady state (the M4 kernel has no fill scatter); the
-        weighted M4b kernel is fill-capable."""
+        contract holds; everything else falls back to XLA.  All three
+        kernels (algl M4, weighted M4b, distinct) are fill-capable.
+
+        When ``impl="pallas"`` was requested and a tile still falls back,
+        the dispatch decision is no longer invisible: the first fallback
+        logs the reason once per engine (VERDICT r3 item 7)."""
+        reason = self._pallas_fallback_reason(steady, ragged, tile_dtype)
+        if reason is not None and self._config.impl == "pallas":
+            if not self._pallas_fallback_logged:
+                self._pallas_fallback_logged = True
+                import logging
+
+                logging.getLogger(__name__).info(
+                    "impl='pallas' requested but this tile takes the XLA "
+                    "path: %s (logged once per engine)",
+                    reason,
+                )
+        return reason is None
+
+    def _pallas_fallback_reason(
+        self, steady: bool, ragged: bool, tile_dtype
+    ) -> "str | None":
+        """None if the Pallas kernel takes the tile, else why not."""
         if self._config.impl == "xla":
-            return False
-        if ragged or self._map_fn is not None or self._hash_fn is not None:
-            return False
-        if self._ops is _algl and not steady:
-            return False
+            return "impl='xla' configured"
+        if ragged:
+            return "ragged tile (valid mask)"
+        if self._map_fn is not None or self._hash_fn is not None:
+            return "custom map_fn/hash_fn"
         mod = self._pallas_module()
         if not mod.supports(self._state, None, None):
-            return False
+            return "kernel supports() contract (counter/sample dtype)"
         if self._config.distinct:
             # the kernel owns the default-hash embedding: 4-byte *integer*
             # tiles (the XLA path value-converts other dtypes, the kernel
@@ -273,14 +294,19 @@ class ReservoirEngine:
                 jnp.dtype(tile_dtype).itemsize != 4
                 or jnp.dtype(tile_dtype).kind not in "iu"
             ):
-                return False
+                return f"distinct tile dtype {jnp.dtype(tile_dtype)} needs a 4-byte integer"
         elif jnp.dtype(tile_dtype) != self._state.samples.dtype:
-            return False
+            return (
+                f"tile dtype {jnp.dtype(tile_dtype)} != samples dtype "
+                f"{self._state.samples.dtype}"
+            )
         if self._config.impl == "pallas":
-            return True
+            return None
         # auto: Mosaic lowers on TPU only — GPU/CPU backends take the XLA
         # path (the CPU interpreter would also be far slower than XLA)
-        return jax.default_backend() == "tpu"
+        if jax.default_backend() != "tpu":
+            return f"impl='auto' on backend {jax.default_backend()!r}"
+        return None
 
     def _base_update(self, steady: bool, use_pallas: bool):
         """The traceable per-tile update ``(state, tile[, weights][, valid])
@@ -289,11 +315,12 @@ class ReservoirEngine:
         stream scan."""
         if use_pallas:
             mod = self._pallas_module()
-            kernel = (
-                mod.update_steady_pallas
-                if self._ops is _algl
-                else mod.update_pallas
-            )
+            if self._ops is _algl:
+                kernel = (
+                    mod.update_steady_pallas if steady else mod.update_pallas
+                )
+            else:
+                kernel = mod.update_pallas
             base = functools.partial(
                 kernel, interpret=jax.default_backend() == "cpu"
             )

@@ -130,6 +130,15 @@ def _finish_load(lib: ctypes.CDLL) -> ctypes.CDLL:
         ctypes.c_void_p,
         ctypes.c_void_p,
     ]
+    if hasattr(lib, "rsv_staging_attach"):  # absent in a stale pre-r4 .so
+        lib.rsv_staging_attach.restype = ctypes.c_int32
+        lib.rsv_staging_attach.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_void_p,
+            ctypes.c_void_p,
+        ]
+        lib.rsv_staging_take.restype = ctypes.c_int64
+        lib.rsv_staging_take.argtypes = [ctypes.c_void_p, ctypes.c_void_p]
     if hasattr(lib, "rsv_bottomk_scan"):  # absent only in a stale pre-r2 .so
         lib.rsv_bottomk_scan.restype = ctypes.c_int64
         lib.rsv_bottomk_scan.argtypes = [
@@ -227,6 +236,81 @@ class NativeStaging:
     def available(self) -> bool:
         """True when the C++ path is live (False: numpy fallback)."""
         return self._lib is not None
+
+    # --------------------------------------------------------- zero-copy mode
+
+    def supports_attach(self) -> bool:
+        """True when the zero-copy flush mode is available (native lib with
+        the attach/take ABI, or the numpy fallback which emulates it)."""
+        return self._lib is None or hasattr(self._lib, "rsv_staging_attach")
+
+    def attach(self, tile: np.ndarray,
+               weights: Optional[np.ndarray] = None) -> None:
+        """Scatter future pushes straight into caller-owned buffers (the
+        bridge's zero-copy flush mode): ``tile`` is ``[S, B]`` of the
+        staging dtype, ``weights`` the parallel float32 tile iff weighted.
+        The caller must keep the arrays alive while attached and must not
+        read them concurrently with pushes (single-producer contract)."""
+        if tile.shape != (self._S, self._B) or tile.dtype != self._dtype:
+            raise ValueError(
+                f"tile must be [{self._S}, {self._B}] {self._dtype}"
+            )
+        if not tile.flags["C_CONTIGUOUS"]:
+            raise ValueError("attached tile must be C-contiguous")
+        if self._weighted != (weights is not None):
+            raise ValueError("weights tile required iff staging is weighted")
+        if weights is not None and not (
+            weights.flags["C_CONTIGUOUS"]
+            and weights.shape == (self._S, self._B)
+            and weights.dtype == np.float32
+        ):
+            raise ValueError(
+                f"weights must be C-contiguous [{self._S}, {self._B}] float32"
+            )
+        if self._lib is not None:
+            if not hasattr(self._lib, "rsv_staging_attach"):
+                raise RuntimeError(
+                    "stale native library without the attach ABI; "
+                    "load_library(rebuild=True)"
+                )
+            rc = self._lib.rsv_staging_attach(
+                self._handle,
+                tile.ctypes.data_as(ctypes.c_void_p),
+                weights.ctypes.data_as(ctypes.c_void_p)
+                if weights is not None
+                else None,
+            )
+            if rc != 0:
+                raise ValueError("invalid attach arguments")
+            # keep the arrays alive while the C side holds raw pointers
+            self._attached = (tile, weights)
+        else:
+            self._buf = tile
+            self._wbuf = weights
+
+    def take(self, out_valid: np.ndarray) -> int:
+        """The zero-copy drain: copy per-row fill counts into ``out_valid``
+        and reset them.  Tile data is already in the attached buffers."""
+        if out_valid.shape != (self._S,) or out_valid.dtype != np.int32:
+            raise ValueError(f"out_valid must be [{self._S}] int32")
+        if not out_valid.flags["C_CONTIGUOUS"]:
+            raise ValueError("out_valid must be C-contiguous")
+        if self._lib is not None:
+            if not hasattr(self._lib, "rsv_staging_take"):
+                raise RuntimeError(
+                    "stale native library without the attach ABI; "
+                    "load_library(rebuild=True)"
+                )
+            total = self._lib.rsv_staging_take(
+                self._handle, out_valid.ctypes.data_as(ctypes.c_void_p)
+            )
+            if total < 0:
+                raise ValueError("invalid take arguments")
+            return int(total)
+        out_valid[...] = self._fill
+        total = int(self._fill.sum())
+        self._fill[:] = 0
+        return total
 
     # ------------------------------------------------------------------ push
 

@@ -378,6 +378,16 @@ def update_steady(
     return _update(state, batch, valid, map_fn, fill=False)
 
 
+def _wide_size(count: jax.Array, k: int) -> jax.Array:
+    """``min(count, k)`` as int32 for WIDE ``[..., 2]`` uint32-plane counts
+    (k always fits int32) — the one clamp shared by every wide consumer."""
+    lo_w = u64e.lo(count)
+    return jnp.where(
+        (u64e.hi(count) > 0) | (lo_w >= k), jnp.int32(k),
+        lo_w.astype(jnp.int32),
+    )
+
+
 def merge_samples(
     samples_a: jax.Array,
     count_a: jax.Array,
@@ -406,21 +416,55 @@ def merge_samples(
     draw ``r`` uniform in ``[0, rem_a + rem_b)`` and take from A iff
     ``r < rem_a`` — exact at any magnitude the count dtype holds (the former
     f32 compare was O(2^-24)-biased past 2^24 elements per shard pair).
+
+    Count dtypes and exactness domains:
+
+    - int32 counts: internal arithmetic is widened to uint32, so the merge
+      is exact for any *combined* total < 2^32 (two int32 inputs can never
+      exceed it); the returned count is uint32 so the total cannot wrap.
+      Tree folds of uint32 counts stay exact while each pair's combined
+      total is < 2^32 — beyond that, use ``count_dtype="wide"``.
+    - int64 counts (x64 on): exact at any magnitude, returned as int64.
+    - WIDE counts (``[R, 2]`` uint32 planes, x64 off): the hypergeometric
+      scan runs on emulated-uint64 planes (:mod:`..ops.u64e`, 64-bit
+      rejection sampling via :func:`_randint_exact_u64e`) — exact at any
+      magnitude, returned as wide planes.  This is the distributed-merge
+      endgame for >2^31-per-reservoir streams (``Sampler.scala:203``'s
+      ``Long`` contract, without global x64).
     """
     k = samples_a.shape[1]
-    if count_a.ndim == 2 or count_b.ndim == 2:
-        raise NotImplementedError(
-            "merge_samples on WIDE (emulated-uint64) counts is not "
-            "supported: the hypergeometric pick needs 64-bit integer "
-            "arithmetic — enable x64 and use int64 counters to merge "
-            "streams beyond 2^32 elements per shard pair"
+    wide = count_a.ndim == 2 or count_b.ndim == 2
+    if wide and not (count_a.ndim == 2 and count_b.ndim == 2):
+        raise ValueError(
+            "merge_samples: both counts must be WIDE [R, 2] planes or both "
+            "narrow [R] — mixed-width merges are ambiguous; promote the "
+            "narrow side with u64e.make(count, 0) first"
         )
+
+    def _subset_gather(s_a, s_b, sz_a, sz_b, j_a, m, key_r):
+        # uniform j_a-subset of A and (m - j_a)-subset of B via masked
+        # argsort; draw indices k and k+1 are disjoint from the scan's t < k
+        perm_a = _masked_perm(jr.fold_in(key_r, k), k, sz_a)
+        perm_b = _masked_perm(jr.fold_in(key_r, k + 1), k, sz_b)
+        pos = jnp.arange(k)
+        from_a = pos < j_a
+        idx = jnp.where(from_a, perm_a[pos], perm_b[jnp.maximum(pos - j_a, 0)])
+        merged = jnp.where(from_a, s_a[idx], s_b[idx])
+        return jnp.where(pos < m, merged, jnp.zeros((), s_a.dtype))
 
     def one(s_a, c_a, s_b, c_b, key_r):
         sz_a = jnp.minimum(c_a, k)
         sz_b = jnp.minimum(c_b, k)
-        total = c_a + c_b
-        m = jnp.minimum(total, k).astype(jnp.int32)
+        if jnp.dtype(c_a.dtype).itemsize == 8:
+            # x64 path: int64 sums are exact at any reachable magnitude
+            c_a_w, c_b_w = c_a, c_b
+        else:
+            # widen int32/uint32 internally: the sum of two int32 counts
+            # can pass 2^31 (ADVICE r3 #1) but never 2^32
+            c_a_w = c_a.astype(jnp.uint32)
+            c_b_w = c_b.astype(jnp.uint32)
+        total = c_a_w + c_b_w
+        m = jnp.minimum(total, jnp.asarray(k, total.dtype)).astype(jnp.int32)
         kw1, kw2 = key_words(key_r)
 
         def step(carry, t):
@@ -428,7 +472,7 @@ def merge_samples(
             from .threefry import fold_in_words
 
             f1, f2 = fold_in_words(kw1, kw2, t)
-            denom = jnp.maximum(rem_a + rem_b, 1)  # inactive lanes: denom 0
+            denom = jnp.maximum(rem_a + rem_b, jnp.asarray(1, total.dtype))
             r = _randint_exact(f1, f2, denom)
             # r uniform in [0, rem_a + rem_b) makes the edge guards of the
             # f32 version redundant: rem_a == 0 -> never picks A,
@@ -444,20 +488,44 @@ def merge_samples(
             ), None
 
         (rem_a, rem_b, j_a), _ = jax.lax.scan(
-            step, (c_a, c_b, jnp.asarray(0, jnp.int32)), jnp.arange(k)
+            step, (c_a_w, c_b_w, jnp.asarray(0, jnp.int32)), jnp.arange(k)
         )
-        # uniform j_a-subset of A and (m - j_a)-subset of B via masked
-        # argsort; draw indices k and k+1 are disjoint from the scan's t < k
-        perm_a = _masked_perm(jr.fold_in(key_r, k), k, sz_a)
-        perm_b = _masked_perm(jr.fold_in(key_r, k + 1), k, sz_b)
-        pos = jnp.arange(k)
-        from_a = pos < j_a
-        idx = jnp.where(from_a, perm_a[pos], perm_b[jnp.maximum(pos - j_a, 0)])
-        merged = jnp.where(from_a, s_a[idx], s_b[idx])
-        merged = jnp.where(pos < m, merged, jnp.zeros((), s_a.dtype))
+        merged = _subset_gather(s_a, s_b, sz_a, sz_b, j_a, m, key_r)
         return merged, total
 
-    samples, count = jax.vmap(one)(
+    def one_wide(s_a, c_a, s_b, c_b, key_r):
+        # c_* are [2] uint32 planes per reservoir (vmapped over R)
+        sz_a = _wide_size(c_a, k)
+        sz_b = _wide_size(c_b, k)
+        total = u64e.add64(c_a, c_b)
+        m = _wide_size(total, k)
+        kw1, kw2 = key_words(key_r)
+
+        def step(carry, t):
+            rem_a, rem_b, j_a = carry
+            from .threefry import fold_in_words
+
+            f1, f2 = fold_in_words(kw1, kw2, t)
+            denom = u64e.add64(rem_a, rem_b)
+            denom = jnp.where(u64e.is_zero(denom), u64e.from_int(1), denom)
+            r = _randint_exact_u64e(f1, f2, denom)
+            pick_a = u64e.lt(r, rem_a)
+            active = t < m
+            take_a = active & pick_a
+            take_b = active & ~pick_a
+            return (
+                u64e.sub_u32(rem_a, take_a.astype(jnp.uint32)),
+                u64e.sub_u32(rem_b, take_b.astype(jnp.uint32)),
+                j_a + take_a.astype(jnp.int32),
+            ), None
+
+        (rem_a, rem_b, j_a), _ = jax.lax.scan(
+            step, (c_a, c_b, jnp.asarray(0, jnp.int32)), jnp.arange(k)
+        )
+        merged = _subset_gather(s_a, s_b, sz_a, sz_b, j_a, m, key_r)
+        return merged, total
+
+    samples, count = jax.vmap(one_wide if wide else one)(
         samples_a, count_a, samples_b, count_b,
         jr.split(key, samples_a.shape[0]),
     )
@@ -468,11 +536,18 @@ def merge(
     state_a: ReservoirState, state_b: ReservoirState, key: jax.Array
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """State-level convenience wrapper over :func:`merge_samples`; returns
-    ``(samples [R, k], size [R], count [R])``."""
+    ``(samples [R, k], size [R], count [R])`` (``size`` is int32 for wide
+    states; ``count`` keeps the states' width)."""
     samples, count = merge_samples(
         state_a.samples, state_a.count, state_b.samples, state_b.count, key
     )
-    size = jnp.minimum(count, state_a.k).astype(count.dtype)
+    k = state_a.k
+    if count.ndim == 2:
+        size = _wide_size(count, k)
+    else:
+        size = jnp.minimum(count, k).astype(
+            jnp.int32 if count.dtype == jnp.uint32 else count.dtype
+        )
     return samples, size, count
 
 
@@ -525,6 +600,44 @@ def _randint_exact(f1: jax.Array, f2: jax.Array, denom: jax.Array) -> jax.Array:
     return (bits % ud).astype(denom.dtype)
 
 
+def _randint_exact_u64e(
+    f1: jax.Array, f2: jax.Array, denom: jax.Array
+) -> jax.Array:
+    """:func:`_randint_exact` on emulated-uint64 planes (x64 off).
+
+    ``denom`` is ``[..., 2]`` uint32 planes, >= 1.  Same rejection scheme:
+    accept a fresh 64-bit draw below ``2^64 - (2^64 mod denom)``, reduce
+    mod ``denom`` — both computed exactly with :func:`..ops.u64e.mod64`
+    restoring division (``2^64 mod d == (2^64 - d) mod d``, and ``2^64 - d``
+    is the wrapping negation of ``d``).  Draw ``a`` hashes block ``(1, a)``
+    of the folded key, bit-identical block layout to the narrow paths.
+    """
+    from .threefry import threefry2x32
+
+    zero = jnp.zeros_like(denom)
+    space_mod = u64e.mod64(u64e.sub64(zero, denom), denom)
+    accept_all = u64e.is_zero(space_mod)
+    thresh = u64e.sub64(zero, space_mod)
+    one_blk = jnp.ones_like(jnp.asarray(f1, jnp.uint32))
+
+    def draw(a):
+        b0, b1 = threefry2x32(f1, f2, one_blk, one_blk * jnp.uint32(0) + a)
+        return u64e.make(b1, b0)
+
+    def cond(carry):
+        _, bits = carry
+        return ~(accept_all | u64e.lt(bits, thresh))
+
+    def body(carry):
+        a, _ = carry
+        return a + jnp.uint32(1), draw(a + jnp.uint32(1))
+
+    _, bits = jax.lax.while_loop(
+        cond, body, (jnp.uint32(0), draw(jnp.uint32(0)))
+    )
+    return u64e.mod64(bits, denom)
+
+
 def _masked_perm(key: jax.Array, k: int, size) -> jax.Array:
     """A random permutation of ``[0, size)`` padded into k slots: draw k
     uniforms, push invalid slots to +inf, argsort."""
@@ -540,12 +653,7 @@ def result(state: ReservoirState) -> Tuple[jax.Array, jax.Array]:
     zeros, never sampled data.  ``size`` is int32 for wide states (k is
     always < 2^31)."""
     if state.wide:
-        lo = u64e.lo(state.count)
-        size = jnp.where(
-            (u64e.hi(state.count) > 0) | (lo >= state.k),
-            jnp.int32(state.k),
-            lo.astype(jnp.int32),
-        )
+        size = _wide_size(state.count, state.k)
     else:
         size = jnp.minimum(state.count, state.k).astype(state.count.dtype)
     mask = jnp.arange(state.k)[None, :] < size[:, None]

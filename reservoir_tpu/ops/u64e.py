@@ -32,7 +32,13 @@ __all__ = [
     "hi",
     "add_u32",
     "add_f32",
+    "add64",
+    "sub_u32",
+    "sub64",
     "le",
+    "lt",
+    "is_zero",
+    "mod64",
     "diff_small",
     "to_f32",
     "to_int",
@@ -87,11 +93,69 @@ def add_f32(a: jax.Array, f: jax.Array) -> jax.Array:
     return make(lo_n, a[..., 1] + hi_f.astype(jnp.uint32) + carry)
 
 
+def add64(a: jax.Array, b: jax.Array) -> jax.Array:
+    """``a + b`` for two logical uint64s (wrapping mod 2^64)."""
+    lo_n = a[..., 0] + b[..., 0]
+    carry = (lo_n < a[..., 0]).astype(jnp.uint32)
+    return make(lo_n, a[..., 1] + b[..., 1] + carry)
+
+
+def sub_u32(a: jax.Array, d) -> jax.Array:
+    """``a - d`` for ``d`` a uint32 (borrow-propagating, wrapping)."""
+    d = jnp.asarray(d, jnp.uint32)
+    borrow = (a[..., 0] < d).astype(jnp.uint32)
+    return make(a[..., 0] - d, a[..., 1] - borrow)
+
+
+def sub64(a: jax.Array, b: jax.Array) -> jax.Array:
+    """``a - b`` for two logical uint64s (wrapping mod 2^64)."""
+    borrow = (a[..., 0] < b[..., 0]).astype(jnp.uint32)
+    return make(a[..., 0] - b[..., 0], a[..., 1] - b[..., 1] - borrow)
+
+
 def le(a: jax.Array, b: jax.Array) -> jax.Array:
     """``a <= b`` as 64-bit unsigned lexicographic compare."""
     return (a[..., 1] < b[..., 1]) | (
         (a[..., 1] == b[..., 1]) & (a[..., 0] <= b[..., 0])
     )
+
+
+def lt(a: jax.Array, b: jax.Array) -> jax.Array:
+    """``a < b`` as 64-bit unsigned lexicographic compare."""
+    return ~le(b, a)
+
+
+def is_zero(a: jax.Array) -> jax.Array:
+    return (a[..., 0] == 0) & (a[..., 1] == 0)
+
+
+def mod64(a: jax.Array, d: jax.Array) -> jax.Array:
+    """``a mod d`` for logical uint64s, ``d >= 1`` — restoring long
+    division, 64 shift-subtract steps on the planes.
+
+    Correct for any ``d`` including ``d > 2^63``: the bit shifted out of
+    the 64-bit remainder window forces a subtraction, and the wrapping
+    :func:`sub64` then yields the true (in-range) remainder because the
+    pre-subtraction value is always < 2·d.  O(64) vectorized iterations —
+    intended for cold paths (result-level merges), not per-element loops.
+    """
+    a_lo, a_hi = a[..., 0], a[..., 1]
+
+    def body(i, rem):
+        idx = (jnp.uint32(63) - jnp.asarray(i, jnp.uint32))
+        use_hi = idx >= jnp.uint32(32)
+        word = jnp.where(use_hi, a_hi, a_lo)
+        sh = jnp.where(use_hi, idx - jnp.uint32(32), idx)
+        bit = (word >> sh) & jnp.uint32(1)
+        shifted_out = rem[..., 1] >> 31
+        rem2 = make(
+            (rem[..., 0] << 1) | bit,
+            (rem[..., 1] << 1) | (rem[..., 0] >> 31),
+        )
+        need = (shifted_out == 1) | ~lt(rem2, d)
+        return jnp.where(need[..., None], sub64(rem2, d), rem2)
+
+    return jax.lax.fori_loop(0, 64, body, jnp.zeros_like(a))
 
 
 def diff_small(a: jax.Array, b: jax.Array) -> jax.Array:

@@ -80,9 +80,11 @@ def _default_hash(value: Any) -> int:
     if value is None:
         return _fnv(b"N")
     if isinstance(value, str):
-        value = value.encode("utf-8")
+        # domain-separated from bytes: 'a' != b'a' must not collide
+        # (ADVICE r3 #2), matching the b"f"/b"N"/b"T"/b"S" prefixes
+        return _fnv(b"s" + value.encode("utf-8"))
     if isinstance(value, (bytes, bytearray)):
-        return _fnv(value)
+        return _fnv(b"b" + bytes(value))
     if isinstance(value, tuple):
         h = _fnv(b"T")
         for item in value:

@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 from concurrent.futures import Future
 from typing import Any, List, Optional, Union
 
@@ -175,8 +176,25 @@ class DeviceStreamBridge:
         )
         self._valids = [np.zeros(S, np.int32) for _ in range(n_bufs)]
         self._buf = 0
+        # Zero-copy flush mode (r4 config-5 host-path work): the demux
+        # scatters straight into the active flush tile, so a flush is a
+        # fill-count read + buffer swap instead of an [S, B] drain copy
+        # (134 MB per flush at config-5 scale).  Pipeline depth drops to 1
+        # permit: reserve() then guarantees the tile being attached next is
+        # no longer read by the worker — same steady-state overlap (demux
+        # of tile B rides tile A's transfer+dispatch), no copy.
+        self._zero_copy = self._staging.supports_attach()
+        if self._zero_copy:
+            self._staging.attach(
+                self._tiles[0],
+                self._wtiles[0] if self._wtiles is not None else None,
+            )
         self._pipeline = (
-            _FlushPipeline(self._dispatch_flush) if pipelined else None
+            _FlushPipeline(
+                self._dispatch_flush, n_tiles=1 if self._zero_copy else 2
+            )
+            if pipelined
+            else None
         )
         self._future: Future = Future()
         self._metrics = BridgeMetrics()
@@ -223,11 +241,13 @@ class DeviceStreamBridge:
         off = 0
         n = arr.shape[0]
         while off < n:
+            t0 = time.perf_counter()
             took = self._staging.push_chunk(
                 stream,
                 arr[off:],
                 warr[off:] if warr is not None else None,
             )
+            self._metrics.demux_s += time.perf_counter() - t0
             off += took
             if off < n or self._staging.row_full(stream):
                 self.flush()
@@ -249,11 +269,13 @@ class DeviceStreamBridge:
         off = 0
         n = arr.shape[0]
         while off < n:
+            t0 = time.perf_counter()
             took = self._staging.push_interleaved(
                 streams[off:],
                 arr[off:],
                 warr[off:] if warr is not None else None,
             )
+            self._metrics.demux_s += time.perf_counter() - t0
             off += took
             if off < n:
                 self.flush()
@@ -292,6 +314,7 @@ class DeviceStreamBridge:
 
     def _dispatch_flush(self, tile, valid, wtile) -> None:
         """The device half of a flush (worker thread when pipelined)."""
+        t0 = time.perf_counter()
         with trace_span("reservoir_bridge_flush"):
             if wtile is not None:
                 # stale weight-slots past each row's valid count hold old
@@ -301,14 +324,45 @@ class DeviceStreamBridge:
                 self._engine.sample(tile, valid=valid, weights=wtile)
             else:
                 self._engine.sample(tile, valid=valid)
+        self._metrics.dispatch_s += time.perf_counter() - t0
 
     def flush(self) -> None:
         """Dispatch buffered elements (ragged tile) to the device.
 
-        Pipelined mode drains into the idle host tile and hands it to the
-        worker — blocking only while BOTH tiles are busy — so the next
-        demux overlaps this flush's transfer+dispatch.
+        Zero-copy mode (the default): the demux already scattered into the
+        active host tile, so the flush reads the fill counts, hands the
+        tile to the worker, and re-points the demux at the other tile —
+        blocking only while that tile's previous flush is still in flight.
+        Copy mode (stale native lib): drain-copies staging into the idle
+        tile first.  Either way the next demux overlaps this flush's
+        transfer+dispatch when pipelined.
         """
+        if self._zero_copy:
+            i = self._buf
+            tile, valid = self._tiles[i], self._valids[i]
+            wtile = self._wtiles[i] if self._wtiles is not None else None
+            t0 = time.perf_counter()
+            total = self._staging.take(valid)
+            self._metrics.drain_s += time.perf_counter() - t0
+            if total == 0:
+                return
+            if self._pipeline is not None:
+                # wait until the OTHER tile's previous flight is done,
+                # then swap the demux onto it
+                self._pipeline.reserve()
+                self._pipeline.submit(tile, valid, wtile)
+                self._buf = 1 - i
+                self._staging.attach(
+                    self._tiles[self._buf],
+                    self._wtiles[self._buf]
+                    if self._wtiles is not None
+                    else None,
+                )
+            else:
+                self._dispatch_flush(tile, valid, wtile)
+            self._metrics.flushes += 1
+            self._metrics.flushed_elements += total
+            return
         if self._pipeline is not None:
             # block until the tile we are about to drain into is truly
             # free (the worker may still be reading it)
@@ -316,7 +370,9 @@ class DeviceStreamBridge:
         i = self._buf
         tile, valid = self._tiles[i], self._valids[i]
         wtile = self._wtiles[i] if self._wtiles is not None else None
+        t0 = time.perf_counter()
         total = self._staging.drain(tile, valid, wtile)
+        self._metrics.drain_s += time.perf_counter() - t0
         if total == 0:
             if self._pipeline is not None:
                 self._pipeline.release()

@@ -52,14 +52,32 @@ __all__ = ["SampleServer"]
 
 _MAGIC = b"RSV1"
 
+# Largest batch a single ``B`` frame may carry (ADVICE r3 #3): the wire
+# count is untrusted u32, and without a cap a corrupt/malicious header
+# could demand an 8*2^32 ~= 32 GiB allocation.  2^24 elements (128 MiB)
+# is far beyond any sane shim flush (the JVM stage flushes ~2^16).
+MAX_FRAME_ELEMS = 1 << 24
 
-def _recv_exact(sock: socket.socket, n: int) -> bytes:
-    buf = b""
-    while len(buf) < n:
-        chunk = sock.recv(n - len(buf))
-        if not chunk:
+# Largest ``k`` a handshake may request, for the same reason: samplers
+# preallocate O(k) state, so an untrusted u32 k near MAX_SIZE (2^31-3
+# passes eager validation) would OOM the server from a few wire bytes.
+MAX_HANDSHAKE_K = 1 << 24
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytearray:
+    # preallocated buffer + recv_into: O(n) for large frames (``bytes``
+    # concatenation re-copies the prefix per chunk, O(n^2))
+    buf = bytearray(n)
+    view = memoryview(buf)
+    got = 0
+    while got < n:
+        r = sock.recv_into(view[got:], n - got)
+        if not r:
             raise ConnectionError("peer closed mid-frame")
-        buf += chunk
+        got += r
+    # hand the bytearray back as-is: every consumer (slice compare,
+    # struct.unpack, np.frombuffer) takes the buffer protocol, and a
+    # bytes() round-trip would re-copy each max-size frame
     return buf
 
 
@@ -72,12 +90,20 @@ class _Handler(socketserver.BaseRequestHandler):
             return
         mode = head[len(_MAGIC)]
         (k,) = struct.unpack(">I", head[len(_MAGIC) + 1 :])
+        if k > MAX_HANDSHAKE_K:
+            sock.close()  # untrusted k: refuse before any O(k) allocation
+            return
         sampler = self.server._make_sampler(mode, k)  # type: ignore[attr-defined]
         try:
             while True:
                 tag = _recv_exact(sock, 1)
                 if tag == b"B":
                     (count,) = struct.unpack(">I", _recv_exact(sock, 4))
+                    if count > MAX_FRAME_ELEMS:
+                        raise ConnectionError(
+                            f"batch frame of {count} elements exceeds "
+                            f"MAX_FRAME_ELEMS={MAX_FRAME_ELEMS}"
+                        )
                     data = _recv_exact(sock, 8 * count)
                     elems = np.frombuffer(data, dtype=">i8").astype(np.int64)
                     sampler.sample_all(elems)
@@ -148,7 +174,10 @@ class SampleServer:
         return self
 
     def close(self) -> None:
-        self._server.shutdown()
+        # shutdown() blocks on an event only serve_forever() sets — calling
+        # it when start() never ran would deadlock (ADVICE r3 #4)
+        if self._thread.is_alive():
+            self._server.shutdown()
         self._server.server_close()
 
     def __enter__(self) -> "SampleServer":

@@ -144,3 +144,37 @@ def test_device_sampler_factory_over_wire():
         sock.close()
     assert res.shape == (6,)
     assert set(res.tolist()) <= set(range(300))
+
+
+def test_close_without_start_does_not_deadlock():
+    # ADVICE r3 #4: shutdown() waits on an event only serve_forever sets;
+    # close() on a never-started server must return, not hang
+    srv = SampleServer()
+    srv.close()  # would deadlock before the is_alive() guard
+
+
+def test_oversized_batch_frame_rejected():
+    # ADVICE r3 #3: the u32 frame count is untrusted — a header demanding
+    # 2^32-1 elements (32 GiB) must drop the connection, not allocate
+    with SampleServer() as srv:
+        sock = _connect(srv.address)
+        _handshake(sock, mode=0, k=4)
+        sock.sendall(b"B" + struct.pack(">I", 0xFFFFFFFF))
+        # server abandons the connection; the result round-trip must fail
+        sock.sendall(b"C")
+        with pytest.raises((ConnectionError, AssertionError, socket.timeout)):
+            _recv_exact(sock, 1)
+        sock.close()
+
+
+def test_oversized_handshake_k_rejected():
+    # review r4: the u32 handshake k is as untrusted as frame counts —
+    # k near MAX_SIZE would preallocate O(k) sampler state (~GiBs); the
+    # server must drop the connection before constructing the sampler
+    with SampleServer() as srv:
+        sock = _connect(srv.address)
+        _handshake(sock, mode=0, k=(1 << 31) - 3)
+        with pytest.raises((ConnectionError, AssertionError, socket.timeout)):
+            _send_batch(sock, np.arange(10, dtype=np.int64))
+            _complete(sock)
+        sock.close()

@@ -137,3 +137,44 @@ def test_bridge_weighted_push_interleaved():
     bridge.push_interleaved(streams, elems, weights)
     res = bridge.complete()
     assert all(len(r) == k for r in res)
+
+
+def test_attach_take_zero_copy(fallback):
+    # r4 zero-copy flush mode: the demux scatters straight into the
+    # attached tile; take() hands back fills without copying tile data
+    S, B = 4, 8
+    st = _mk(fallback, S=S, B=B)
+    tile_a = np.zeros((S, B), np.int32)
+    tile_b = np.zeros((S, B), np.int32)
+    valid = np.zeros(S, np.int32)
+    st.attach(tile_a)
+    streams = np.array([0, 1, 1, 3, 0], np.int32)
+    elems = np.array([10, 20, 21, 30, 11], np.int32)
+    assert st.push_interleaved(streams, elems) == 5
+    assert st.take(valid) == 5
+    np.testing.assert_array_equal(valid, [2, 2, 0, 1])
+    # the data IS in the attached tile, no drain copy needed
+    np.testing.assert_array_equal(tile_a[0, :2], [10, 11])
+    np.testing.assert_array_equal(tile_a[1, :2], [20, 21])
+    assert tile_a[3, 0] == 30
+    # swap to the other tile: new pushes land there, old tile untouched
+    st.attach(tile_b)
+    assert st.push_interleaved(
+        np.array([2], np.int32), np.array([99], np.int32)
+    ) == 1
+    assert st.take(valid) == 1
+    assert tile_b[2, 0] == 99
+    assert tile_a[2, 0] == 0
+
+
+def test_attach_validation(fallback):
+    st = _mk(fallback, S=4, B=8)
+    with pytest.raises(ValueError):
+        st.attach(np.zeros((4, 8), np.int64))  # wrong dtype
+    with pytest.raises(ValueError):
+        st.attach(np.zeros((2, 8), np.int32))  # wrong shape
+    with pytest.raises(ValueError):
+        st.attach(np.zeros((4, 8), np.int32), np.zeros((4, 8), np.float32))
+    wst = _mk(fallback, S=4, B=8, weighted=True)
+    with pytest.raises(ValueError):
+        wst.attach(np.zeros((4, 8), np.int32))  # missing weights tile

@@ -280,10 +280,13 @@ def test_default_hash_golden_values_cross_process_stable():
     # (FNV-1a over tagged bytes) — a serialization change (tag bytes, FNV
     # chaining, struct packing) fails here, which is the point: it would
     # silently break every persisted sample.
+    # "tup" re-pinned 2026-07 when str gained the b"s" domain-separation
+    # prefix (ADVICE r3 #2: 'a' vs b'a' collided) — a deliberate,
+    # pre-release serialization change
     golden = {
         "2.5": 9444803886603158309,
         "none": 12638230081509142225,
-        "tup": 15567512925437044543,
+        "tup": 17408104419363371730,
         "fs": 15412025984356971074,
     }
     assert _default_hash(2.5) == golden["2.5"]
@@ -555,3 +558,16 @@ def test_algl_range_fast_path_matches_array_and_python():
     g.sample_all(range(10**10))
     assert g.count == 10**10
     assert all(type(x) is int for x in g.result())
+
+
+def test_default_hash_str_bytes_domain_separated():
+    # ADVICE r3 #2: 'a' != b'a', so their hashes must differ (the reference
+    # distinguishes them via hashCode); tuples recurse through the same
+    # domain-separated digests
+    from reservoir_tpu.oracle.bottom_k import _default_hash
+
+    assert _default_hash("a") != _default_hash(b"a")
+    assert _default_hash("") != _default_hash(b"")
+    assert _default_hash(("x",)) != _default_hash((b"x",))
+    # bytearray and bytes compare equal -> must hash equal
+    assert _default_hash(b"xyz") == _default_hash(bytearray(b"xyz"))

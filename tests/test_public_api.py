@@ -58,3 +58,39 @@ def test_public_api_matches_manifest():
         "public API drift (tools/gen_api_manifest.py --write if intended):\n"
         + "\n".join(drift)
     )
+
+
+def test_backward_compat_checker_semantics():
+    # the release-baseline gate (MiMa-vs-released-artifacts analog,
+    # build.sbt:124-125): additions pass, removals and signature changes
+    # fail — pinned here so the CI gate's tool can't silently regress
+    gen = _load_generator()
+    base = {
+        "m": {
+            "f": {"kind": "function", "signature": "(x)"},
+            "C": {"kind": "class", "methods": {"go": "(self)"}},
+        }
+    }
+    same = json.loads(json.dumps(base))
+    assert gen.check_backward_compat(base, same) == []
+    # additions are compatible (new export, new method, new module)
+    grown = json.loads(json.dumps(base))
+    grown["m"]["g"] = {"kind": "function", "signature": "()"}
+    grown["m"]["C"]["methods"]["stop"] = "(self)"
+    grown["m2"] = {}
+    assert gen.check_backward_compat(base, grown) == []
+    # removal of an export
+    removed = json.loads(json.dumps(base))
+    del removed["m"]["f"]
+    assert any("export removed" in e for e in gen.check_backward_compat(base, removed))
+    # signature change
+    changed = json.loads(json.dumps(base))
+    changed["m"]["f"]["signature"] = "(x, y)"
+    assert any("changed" in e for e in gen.check_backward_compat(base, changed))
+    # method removal / change inside a class
+    mless = json.loads(json.dumps(base))
+    del mless["m"]["C"]["methods"]["go"]
+    assert any("method removed" in e for e in gen.check_backward_compat(base, mless))
+    # whole module removed
+    modless = {"m": base["m"], "gone": {}}
+    assert any("module removed" in e for e in gen.check_backward_compat(modless, base))

@@ -162,12 +162,15 @@ class TestWideOps:
         _, size = al.result(big)
         assert np.all(np.asarray(size) == k)
 
-    def test_merge_wide_raises(self):
+    def test_merge_mixed_width_raises(self):
+        # wide merges are supported (tests/test_merge.py TestWideCountMerge);
+        # what stays an error is mixing a wide and a narrow side
         R, k = 4, 8
         st = al.init(jr.key(3), R, k, count_dtype=al.WIDE)
-        with pytest.raises(NotImplementedError):
+        narrow = al.init(jr.key(4), R, k)
+        with pytest.raises(ValueError, match="mixed-width"):
             al.merge_samples(
-                st.samples, st.count, st.samples, st.count, jr.key(4)
+                st.samples, st.count, narrow.samples, narrow.count, jr.key(5)
             )
 
 

@@ -109,11 +109,63 @@ def build_manifest() -> dict:
     return out
 
 
+def check_backward_compat(baseline: dict, current: dict) -> list:
+    """MiMa-semantics check against a RELEASED baseline manifest: additions
+    are fine; any removal or signature change of a released export breaks
+    compatibility (the reference checks released artifacts the same way,
+    ``build.sbt:58-68,124-125``)."""
+    errors = []
+    for mod, exports in baseline.items():
+        cur_mod = current.get(mod)
+        if cur_mod is None:
+            errors.append(f"module removed: {mod}")
+            continue
+        for name, desc in exports.items():
+            cur = cur_mod.get(name)
+            if cur is None:
+                errors.append(f"export removed: {mod}.{name}")
+            elif (
+                isinstance(desc, dict)
+                and desc.get("kind") == "class"
+                and isinstance(cur, dict)
+                and cur.get("kind") == "class"
+            ):
+                # classes may gain methods; losing or changing one breaks
+                for m, sig in desc.get("methods", {}).items():
+                    cm = cur.get("methods", {}).get(m)
+                    if cm is None:
+                        errors.append(f"method removed: {mod}.{name}.{m}")
+                    elif cm != sig:
+                        errors.append(
+                            f"method changed: {mod}.{name}.{m}: {sig} -> {cm}"
+                        )
+            elif cur != desc:
+                errors.append(f"changed: {mod}.{name}: {desc} -> {cur}")
+    return errors
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--write", action="store_true")
+    ap.add_argument(
+        "--compat",
+        metavar="BASELINE_JSON",
+        help="check backward compatibility against a released manifest "
+        "(additions allowed; removals/changes fail)",
+    )
     args = ap.parse_args()
     manifest = build_manifest()
+    if args.compat:
+        with open(args.compat) as f:
+            baseline = json.load(f)
+        errors = check_backward_compat(baseline, manifest)
+        if errors:
+            print(f"BACKWARD-INCOMPATIBLE vs {args.compat}:")
+            for e in errors:
+                print(f"  - {e}")
+            return 1
+        print(f"backward compatible with {args.compat}")
+        return 0
     if args.write:
         with open(MANIFEST, "w") as f:
             json.dump(manifest, f, indent=1, sort_keys=True)

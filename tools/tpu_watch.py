@@ -28,7 +28,7 @@ import sys
 import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-CAPTURE = os.path.join(REPO, "TPU_CAPTURE_r03.jsonl")
+CAPTURE = os.path.join(REPO, "TPU_CAPTURE_r04.jsonl")
 PROBE_INTERVAL = 180.0
 PROBE_TIMEOUT = 90.0
 BENCH_TIMEOUT = 2400.0
@@ -66,7 +66,15 @@ def capture_bench(config: str, timeout_s: float = BENCH_TIMEOUT) -> str:
     ``"unreachable"`` (the tunnel dropped mid-window — the caller should
     stop burning this window on the remaining configs).
     """
-    env = dict(os.environ, RESERVOIR_BENCH_CONFIG=config)
+    # "bridge_serial" is a pseudo-config: the bridge bench with
+    # double-buffering off, so one window yields the pipelined-vs-serial
+    # delta (VERDICT r3 item 2b) without a second window.
+    extra_env = {}
+    bench_config = config
+    if config == "bridge_serial":
+        bench_config = "bridge"
+        extra_env["RESERVOIR_BENCH_BRIDGE_PIPELINED"] = "0"
+    env = dict(os.environ, RESERVOIR_BENCH_CONFIG=bench_config, **extra_env)
     t0 = time.time()
     try:
         proc = subprocess.run(
@@ -133,12 +141,73 @@ def capture_bench(config: str, timeout_s: float = BENCH_TIMEOUT) -> str:
     return "ok"
 
 
+def _run_post_step(name: str, cmd: list[str], timeout_s: float, env=None) -> bool:
+    """Run one post-capture step (block sweep / device tests) in a child
+    with a hard timeout, appending the outcome to the capture file."""
+    t0 = time.time()
+    try:
+        proc = subprocess.run(
+            cmd,
+            capture_output=True,
+            timeout=timeout_s,
+            text=True,
+            cwd=REPO,
+            env=dict(os.environ, **(env or {})),
+        )
+        rc: int | str = proc.returncode
+        tail = (proc.stdout + "\n" + proc.stderr)[-3000:]
+    except subprocess.TimeoutExpired as e:
+        rc = "timeout"
+        out = e.stdout or b""
+        if isinstance(out, bytes):
+            out = out.decode(errors="replace")
+        tail = out[-3000:]
+    _append(
+        {
+            "ts": _now(),
+            "post_step": name,
+            "rc": rc,
+            "wall_s": round(time.time() - t0, 1),
+            "output_tail": tail,
+        }
+    )
+    print(f"[{_now()}] post-step {name}: rc={rc}", flush=True)
+    return rc == 0
+
+
+# Ordered follow-ups once every bench config is captured: the block sweep
+# (VERDICT r3 item 2a) and the device-gated Pallas parity suite (item 2c).
+# Each runs in its own child with a hard timeout so a tunnel drop or
+# Mosaic compile blowup is recorded, not inherited.
+POST_STEPS: list[tuple[str, list[str], float, dict]] = [
+    (
+        "algl_block_sweep",
+        [sys.executable, os.path.join(REPO, "tools", "tpu_algl_block_sweep.py")],
+        1800.0,
+        {},
+    ),
+    (
+        "pallas_device_tests",
+        [
+            sys.executable,
+            "-m",
+            "pytest",
+            "tests/test_pallas_device.py",
+            "-q",
+            "--no-header",
+        ],
+        1800.0,
+        {"RESERVOIR_TPU_TEST_PLATFORM": "native"},
+    ),
+]
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--max-hours", type=float, default=12.0)
     ap.add_argument(
         "--configs",
-        default="algl",
+        default="algl,transfer,bridge,bridge_serial,distinct,weighted,stream",
         help="comma-separated bench configs to capture when the window opens",
     )
     args = ap.parse_args()
@@ -149,6 +218,7 @@ def main() -> int:
     # waste them), and one persistently failing config can't starve the
     # rest — every remaining config gets its attempt each window.
     remaining = [c for c in args.configs.split(",") if c]
+    post_remaining = list(POST_STEPS)
     while time.time() < deadline:
         attempt += 1
         platform = probe()
@@ -157,6 +227,7 @@ def main() -> int:
             print(f"[{stamp}] tpu UP after {attempt} probes", flush=True)
             _append({"ts": stamp, "event": "tpu_up", "probes": attempt})
             still = []
+            dropped = False
             for i, c in enumerate(remaining):
                 status = capture_bench(c)
                 print(f"[{_now()}] capture {c}: {status}", flush=True)
@@ -167,13 +238,21 @@ def main() -> int:
                     # tunnel dropped mid-window: don't burn ~15 min of
                     # probe/backoff per remaining config on a dead backend
                     still.extend(remaining[i + 1 :])
+                    dropped = True
                     break
             remaining = still
-            if not remaining:
+            if not dropped:
+                post_remaining = [
+                    step
+                    for step in post_remaining
+                    if not _run_post_step(step[0], step[1], step[2], step[3])
+                ]
+            if not remaining and not post_remaining:
                 print(f"[{_now()}] capture complete", flush=True)
                 return 0
             print(
-                f"[{_now()}] still to capture: {remaining}; resuming watch",
+                f"[{_now()}] still to capture: {remaining} "
+                f"+ {[s[0] for s in post_remaining]}; resuming watch",
                 flush=True,
             )
         else:
