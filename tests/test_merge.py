@@ -418,3 +418,29 @@ class TestWideCountMerge:
         # every merged element comes from some shard's reservoir
         pool = set(np.asarray(samples).ravel().tolist())
         assert set(np.asarray(ms).ravel().tolist()) <= pool
+
+    def test_wide_merge_pick_distribution_is_hypergeometric(self):
+        # the wide path's 64-bit rejection sampler must reproduce the same
+        # hypergeometric pick law the narrow path is gated on:
+        # c_a=3, c_b=5, k=4 -> #taken-from-A ~ Hypergeometric(8, 3, 4),
+        # pmf [5, 30, 30, 5]/70
+        from reservoir_tpu.ops import u64e
+
+        R, k, n_a, n_b = 50_000, 4, 3, 5
+        # n_a < k: 3 valid slots + padding; n_b > k: all k slots valid
+        # (a count past k means the k slots hold a uniform k-subset)
+        s_a = jnp.tile(jnp.arange(n_a, dtype=jnp.int32), (R, 1))
+        s_a = jnp.pad(s_a, ((0, 0), (0, k - n_a)))
+        s_b = jnp.tile(10 + jnp.arange(k, dtype=jnp.int32), (R, 1))
+        samples, count = al.merge_samples(
+            s_a, u64e.from_int(n_a, (R,)),
+            s_b, u64e.from_int(n_b, (R,)), jr.key(37),
+        )
+        for r in (0, R - 1):
+            assert u64e.to_int(np.asarray(count)[r]) == n_a + n_b
+        j_a = (np.asarray(samples) < 10).sum(axis=1)
+        pmf = np.array([5, 30, 30, 5]) / 70.0
+        for j in range(k):
+            sigma = math.sqrt(R * pmf[j] * (1 - pmf[j]))
+            got = int((j_a == j).sum())
+            assert abs(got - R * pmf[j]) < 5 * sigma, (j, got)
