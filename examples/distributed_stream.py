@@ -1,0 +1,103 @@
+"""One logical stream, sharded across a device mesh, sampled exactly.
+
+The long-context / stream-axis story end-to-end (SURVEY §5; the axis the
+reference cannot scale — its sampler is one single-threaded object,
+``Sampler.scala:19``):
+
+1. build a mesh and give each device a disjoint shard of one logical
+   stream;
+2. sample every shard independently — the hot loop is collective-free;
+3. combine with the EXACT hypergeometric merge (a log-depth tree riding
+   one ``all_gather``), so the result is distributed identically to
+   sampling the whole stream on one device;
+4. the same fold with ``count_dtype="wide"`` emulated-uint64 counters —
+   per-shard streams past 2^31 elements merge exactly with x64 off.
+
+Runs anywhere: on CPU it self-configures a virtual 8-device mesh
+(``XLA_FLAGS=--xla_force_host_platform_device_count=8``); on a TPU slice
+the same code uses the real chips.  Usage::
+
+    python examples/distributed_stream.py [n_devices]
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+# runnable from a checkout without installation
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(n_devices: int = 8) -> None:
+    if "--xla_force_host_platform_device_count" not in os.environ.get(
+        "XLA_FLAGS", ""
+    ):
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={n_devices}"
+        )
+    import jax
+
+    # Pin the platform BEFORE any backend touch: querying the default
+    # backend would initialize it, which hangs when a tunneled TPU is
+    # down.  Set RESERVOIR_EXAMPLE_PLATFORM=native to run on real chips.
+    if os.environ.get("RESERVOIR_EXAMPLE_PLATFORM", "cpu") == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import jax.random as jr
+    import numpy as np
+
+    from reservoir_tpu.ops import algorithm_l as al
+    from reservoir_tpu.ops import u64e
+    from reservoir_tpu.parallel import make_mesh
+    from reservoir_tpu.parallel.merge import uniform_stream_merger
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    D = n_devices
+    R, k, N = 16, 8, 4096  # R reservoirs, k samples each, N elems per shard
+    mesh = make_mesh(D, axis="stream")
+
+    # 1-2. disjoint shards, sampled independently (zero communication)
+    shard_states = []
+    for d in range(D):
+        st = al.init(jr.fold_in(jr.key(0), d), R, k)
+        shard = jnp.tile(
+            jnp.arange(d * N, (d + 1) * N, dtype=jnp.int32), (R, 1)
+        )
+        shard_states.append(al.update(st, shard))
+
+    # 3. exact merge: one all_gather + a log2(D)-depth tree of
+    # hypergeometric folds, identical on every device (replicated output)
+    sh = NamedSharding(mesh, P("stream"))
+    merged, count = uniform_stream_merger(mesh)(
+        jax.device_put(jnp.stack([s.samples for s in shard_states]), sh),
+        jax.device_put(jnp.stack([s.count for s in shard_states]), sh),
+        jr.key(1),
+    )
+    assert int(np.asarray(count)[0]) == D * N
+    pool = np.asarray(merged)
+    assert pool.min() >= 0 and pool.max() < D * N
+    print(
+        f"narrow merge over {D} devices: {D * N} logical elements -> "
+        f"{k} samples/reservoir, e.g. {sorted(pool[0].tolist())}"
+    )
+
+    # 4. the same fold on WIDE counters: synthetic per-shard counts past
+    # 2^32 merge to the exact 64-bit total (no x64 anywhere)
+    big = (1 << 33) + 7
+    wide_counts = jax.device_put(
+        jnp.stack([u64e.from_int(big + d, (R,)) for d in range(D)]), sh
+    )
+    _, wide_count = uniform_stream_merger(mesh)(
+        jax.device_put(jnp.stack([s.samples for s in shard_states]), sh),
+        wide_counts,
+        jr.key(2),
+    )
+    total = u64e.to_int(np.asarray(wide_count)[0])
+    assert total == sum(big + d for d in range(D)), total
+    print(f"wide merge: exact 64-bit total {total} (> 2^36), x64 off")
+
+
+if __name__ == "__main__":
+    main(int(sys.argv[1]) if len(sys.argv) > 1 else 8)

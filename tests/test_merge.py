@@ -444,3 +444,66 @@ class TestWideCountMerge:
             sigma = math.sqrt(R * pmf[j] * (1 - pmf[j]))
             got = int((j_a == j).sum())
             assert abs(got - R * pmf[j]) < 5 * sigma, (j, got)
+
+
+class TestTreeFoldUniformity:
+    """The SHIPPED tree fold (uniform_stream_merger's log-depth combine)
+    must leave every element of the union stream with inclusion
+    probability k/total — the end-to-end distribution gate over the whole
+    production fold, not a test-local reimplementation."""
+
+    def _shards(self, R, k, D, N):
+        out = []
+        for d in range(D):
+            st = al.init(jr.fold_in(jr.key(50), d), R, k)
+            st = al.update(
+                st,
+                jnp.tile(
+                    jnp.arange(d * N, (d + 1) * N, dtype=jnp.int32), (R, 1)
+                ),
+            )
+            out.append((st.samples, st.count))
+        return out
+
+    def _merged_counts(self, stacked_c, key, R, k, D, N):
+        mesh = make_mesh(D, axis="stream")
+        sh = NamedSharding(mesh, P("stream"))
+        stacked_s = jnp.stack(
+            [s for s, _ in self._shards(R, k, D, N)]
+        )
+        s, c = uniform_stream_merger(mesh)(
+            jax.device_put(stacked_s, sh),
+            jax.device_put(stacked_c, sh),
+            key,
+        )
+        return np.asarray(s), c
+
+    @needs_mesh
+    def test_narrow_tree_uniform_over_union_5_sigma(self):
+        R, k, D, N = 20_000, 4, 8, 10
+        stacked_c = jnp.stack(
+            [c for _, c in self._shards(R, k, D, N)]
+        )
+        s, c = self._merged_counts(stacked_c, jr.key(51), R, k, D, N)
+        assert np.all(np.asarray(c) == D * N)
+        counts = np.bincount(s.ravel(), minlength=D * N)
+        p = k / (D * N)
+        sigma = math.sqrt(R * p * (1 - p))
+        assert np.all(np.abs(counts - R * p) < 5 * sigma), counts
+
+    @needs_mesh
+    def test_wide_tree_uniform_over_union_5_sigma(self):
+        # identical fold, counts carried as emulated-uint64 planes — gates
+        # the one_wide scan + 64-bit rejection sampler end to end through
+        # the production merger
+        from reservoir_tpu.ops import u64e
+
+        R, k, D, N = 20_000, 4, 8, 10
+        stacked_c = jnp.stack([u64e.from_int(N, (R,)) for _ in range(D)])
+        s, c = self._merged_counts(stacked_c, jr.key(52), R, k, D, N)
+        assert c.shape == (R, 2)
+        assert u64e.to_int(np.asarray(c)[0]) == D * N
+        counts = np.bincount(s.ravel(), minlength=D * N)
+        p = k / (D * N)
+        sigma = math.sqrt(R * p * (1 - p))
+        assert np.all(np.abs(counts - R * p) < 5 * sigma), counts

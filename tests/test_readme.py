@@ -34,3 +34,22 @@ def _python_blocks():
 @pytest.mark.parametrize("block", _python_blocks())
 def test_readme_snippet_runs(block):
     exec(compile(block, "<README.md>", "exec"), {"__name__": "__readme__"})
+
+
+def test_distributed_stream_example_runs():
+    # the long-context example must stay executable (same contract as the
+    # README snippets): narrow + wide merges over the virtual mesh
+    import subprocess
+    import sys
+    import os
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.run(
+        [sys.executable, os.path.join(repo, "examples", "distributed_stream.py"), "8"],
+        capture_output=True,
+        text=True,
+        timeout=600,
+        cwd=repo,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "wide merge: exact 64-bit total" in proc.stdout
