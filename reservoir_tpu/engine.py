@@ -377,11 +377,6 @@ class ReservoirEngine:
         weights_host: Optional[np.ndarray] = None
         if self._wide:
             tile_np = np.asarray(tile)
-            if tile_np.dtype.kind not in "iu" or tile_np.dtype.itemsize != 8:
-                raise ValueError(
-                    "this engine samples 64-bit integer keys; got dtype "
-                    f"{tile_np.dtype}"
-                )
             if (
                 tile_np.ndim != 2
                 or tile_np.shape[0] != self._config.num_reservoirs
@@ -582,7 +577,7 @@ class ReservoirEngine:
                 raise ValueError("weights must be nonnegative")
         B = tile_width or self._config.tile_size
         start0 = 0
-        if fused and N >= 2 * B and not self._wide:
+        if fused and N >= 2 * B:
             n_full = N // B
             self._sample_stream_fused(
                 stream[:, : n_full * B],
@@ -631,7 +626,13 @@ class ReservoirEngine:
         R = self._config.num_reservoirs
         # weights were already validated whole-array (incl. NaN rejection)
         # by sample_stream, the sole caller
-        if not self._wide:
+        wide = self._wide
+        if wide:
+            # 64-bit distinct keys ride as (hi, lo) uint32 bit-planes, the
+            # same wide-tile format sample() ships per tile — split ONCE on
+            # the host, then the whole plane pair goes in one transfer
+            stream_hi, stream_lo = _distinct.split_values_host(stream)
+        else:
             canon = jax.dtypes.canonicalize_dtype(stream.dtype)
             if stream.dtype != canon:
                 stream = stream.astype(canon)  # pre-transfer, like sample()
@@ -653,37 +654,43 @@ class ReservoirEngine:
                     if weighted:
                         tile, wt = xs
                         return base(st, tile, wt), None
+                    if wide:
+                        hi, lo = xs
+                        return base(st, (hi, lo)), None
                     return base(st, xs), None
 
-                xs = (tiles, wtiles) if weighted else tiles
+                if weighted:
+                    xs = (tiles, wtiles)
+                else:
+                    xs = tiles  # wide mode: a (hi, lo) pair of [n, R, B]
                 state, _ = jax.lax.scan(body, state, xs)
                 return state
 
             fn = jax.jit(scan_fn, donate_argnums=(0,))
             self._jit_cache[cache_key] = fn
-        tiles = np.ascontiguousarray(
-            stream.reshape(R, n_full, B).swapaxes(0, 1)
-        )
-        if np.shares_memory(tiles, stream):
-            # R == 1 makes the transpose a no-op view of the CALLER's
-            # buffer — snapshot before the async device_put (the same
-            # contract sample() keeps with np.array(copy=True))
-            tiles = tiles.copy()
-        stage = {"tiles": tiles}
+        def to_tiles(arr):
+            t = np.ascontiguousarray(arr.reshape(R, n_full, B).swapaxes(0, 1))
+            if np.shares_memory(t, arr):
+                # R == 1 makes the transpose a no-op view of the CALLER's
+                # buffer — snapshot before the async device_put (the same
+                # contract sample() keeps with np.array(copy=True))
+                t = t.copy()
+            return t
+
+        if wide:
+            # hi/lo are freshly allocated above, so the async read is safe
+            stage = {"tiles": (to_tiles(stream_hi), to_tiles(stream_lo))}
+        else:
+            stage = {"tiles": to_tiles(stream)}
         if weights is not None:
-            wtiles = np.ascontiguousarray(
-                weights.reshape(R, n_full, B).swapaxes(0, 1)
-            )
-            if np.shares_memory(wtiles, weights):
-                wtiles = wtiles.copy()
-            stage["weights"] = wtiles
+            stage["weights"] = to_tiles(weights)
         if self._mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec as _P
 
             sh = NamedSharding(
                 self._mesh, _P(None, self._config.mesh_axis, None)
             )
-            placed = jax.device_put(stage, {k: sh for k in stage})
+            placed = jax.device_put(stage, jax.tree.map(lambda _: sh, stage))
         else:
             placed = jax.device_put(stage)
         if weights is not None:

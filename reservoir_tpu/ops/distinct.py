@@ -89,15 +89,27 @@ class DistinctState(NamedTuple):
         return self.value_hi is not None
 
 
-def split_values(values: np.ndarray) -> Tuple[jax.Array, jax.Array]:
-    """Split a host int64/uint64 array into ``(hi, lo)`` uint32 device planes
-    — the wide-mode tile format."""
+def split_values_host(values: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+    """Split a host int64/uint64 array into ``(hi, lo)`` uint32 HOST planes
+    — the single owner of the wide-tile bit layout and its dtype check
+    (used per-tile via :func:`split_values` and whole-stream by the
+    engine's fused scan, which reshapes the planes before one staged
+    transfer)."""
     v = np.asarray(values)
     if v.dtype.itemsize != 8 or v.dtype.kind not in "iu":
-        raise ValueError(f"expected a 64-bit integer array, got {v.dtype}")
+        raise ValueError(
+            f"expected 64-bit integer keys; got dtype {v.dtype}"
+        )
     u = v.view(np.uint64)
     hi = (u >> np.uint64(32)).astype(np.uint32)
     lo = (u & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+    return hi, lo
+
+
+def split_values(values: np.ndarray) -> Tuple[jax.Array, jax.Array]:
+    """Split a host int64/uint64 array into ``(hi, lo)`` uint32 device planes
+    — the wide-mode tile format."""
+    hi, lo = split_values_host(values)
     # device_put (async) over jnp.asarray (chunked-synchronous on tunneled
     # backends); hi/lo are freshly allocated above, so the async read is safe
     return jax.device_put(hi), jax.device_put(lo)

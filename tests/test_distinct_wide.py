@@ -229,3 +229,28 @@ class TestBridgeWide:
             vals = [int(v) for v in r]
             assert len(vals) == len(set(vals)) == min(8, len(pool))
             assert all(v in pool for v in vals)
+
+
+def test_sample_stream_fused_wide_bit_identical():
+    # r4: the fused scan now covers 64-bit keys — host plane-split once,
+    # one transfer, one scanned dispatch; bit-identical to per-tile
+    rng = np.random.default_rng(23)
+    R, k, B, N = 4, 8, 32, 5 * 32 + 7  # 5 full tiles + ragged tail
+    stream = rng.integers(0, 1 << 50, (R, N), dtype=np.int64)
+    outs = []
+    for fused in (False, True):
+        e = ReservoirEngine(
+            SamplerConfig(
+                max_sample_size=k,
+                num_reservoirs=R,
+                tile_size=B,
+                element_dtype="int64",
+                distinct=True,
+            ),
+            key=9,
+            reusable=True,
+        )
+        e.sample_stream(stream, fused=fused)
+        outs.append(e.result_arrays())
+    np.testing.assert_array_equal(outs[0][0], outs[1][0])
+    np.testing.assert_array_equal(outs[0][1], outs[1][1])
