@@ -1,0 +1,102 @@
+"""Re-capture the headline algl bench at the best swept block size.
+
+Runs as the watcher's final post-step (sequentially gated: only after
+``tpu_algl_block_sweep.py`` completed this run), reading the per-block
+compile/throughput records it appended to ``TPU_BLOCK_SWEEP.jsonl``:
+pick the block with the highest steady-state throughput among blocks
+that compiled sanely (compile+first-run under ``--max-compile-s``),
+and — if it beats the default block 64 — run one more ``bench.py`` algl
+capture with ``RESERVOIR_BENCH_BLOCK_R`` set, via the watcher's own
+``capture_bench`` (same timeout-salvage, same capture file).  This turns
+one hardware window into both the sweep evidence AND a headline number
+at the sweep's winner (VERDICT r3 item 2a), with no second window.
+
+Only records stamped at/after ``--since`` (default: the watcher's
+``TPU_WATCH_RUN_START`` env) count — the sweep file is append-only
+across rounds, and a stale record from an older kernel must never pick
+the winner.
+
+Exit 0 when there is genuinely nothing to do (this run's sweep found no
+block beating 64); exit 1 when the sweep has not produced usable data
+yet, so the sequentially-gated watcher retries both next window.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SWEEP = os.path.join(REPO, "TPU_BLOCK_SWEEP.jsonl")
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+
+def pick_best(max_compile_s: float, since: str) -> "tuple[int, float] | None":
+    """(block_r, elem_per_sec) of the best sanely-compiling block, from the
+    LATEST record per block size stamped >= ``since`` (ISO timestamps
+    compare lexicographically); None without usable data."""
+    if not os.path.exists(SWEEP):
+        return None
+    per_block: dict = {}
+    with open(SWEEP) as f:
+        for line in f:
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if since and rec.get("ts", "") < since:
+                continue
+            res = rec.get("result")
+            if not res or res.get("compile_plus_first_run_s", 1e9) > max_compile_s:
+                continue
+            per_block[res["block_r"]] = res["elem_per_sec"]
+    if not per_block:
+        return None
+    best = max(per_block, key=per_block.get)  # ties: any
+    return best, per_block[best]
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--max-compile-s", type=float, default=120.0)
+    ap.add_argument(
+        "--since",
+        default=os.environ.get("TPU_WATCH_RUN_START", ""),
+        help="ignore sweep records stamped before this ISO timestamp",
+    )
+    args = ap.parse_args()
+    best = pick_best(args.max_compile_s, args.since)
+    if best is None:
+        print(
+            "no usable sweep data for this run yet; retry next window",
+            flush=True,
+        )
+        return 1
+    block, rate = best
+    if block == 64:
+        print(
+            f"block 64 is already the sweep winner ({rate:.3g} elem/s)",
+            flush=True,
+        )
+        return 0
+    print(
+        f"sweep winner: block {block} ({rate:.3g} elem/s); re-capturing "
+        "headline",
+        flush=True,
+    )
+    from tpu_watch import capture_bench
+
+    status = capture_bench(
+        f"algl_block{block}",
+        bench_config="algl",
+        extra_env={"RESERVOIR_BENCH_BLOCK_R": str(block)},
+    )
+    print(f"re-capture at block {block}: {status}", flush=True)
+    return 0 if status == "ok" else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -59,21 +59,31 @@ def _append(record: dict) -> None:
         f.write(json.dumps(record) + "\n")
 
 
-def capture_bench(config: str, timeout_s: float = BENCH_TIMEOUT) -> str:
+def capture_bench(
+    config: str,
+    timeout_s: float = BENCH_TIMEOUT,
+    bench_config: "str | None" = None,
+    extra_env: "dict | None" = None,
+) -> str:
     """Run bench.py for ``config``; append its JSON line + timestamp.
 
-    Returns ``"ok"``, ``"failed"`` (bench error — retry next window), or
-    ``"unreachable"`` (the tunnel dropped mid-window — the caller should
-    stop burning this window on the remaining configs).
+    ``config`` is the label recorded in the capture file; ``bench_config``
+    (default: derived from the label) is what RESERVOIR_BENCH_CONFIG is
+    set to, and ``extra_env`` adds overrides — callers like the
+    best-block re-capture reuse this (and its timeout-salvage) instead of
+    duplicating it.  Returns ``"ok"``, ``"failed"`` (bench error — retry
+    next window), or ``"unreachable"`` (the tunnel dropped mid-window —
+    the caller should stop burning this window on the remaining configs).
     """
     # "bridge_serial" is a pseudo-config: the bridge bench with
     # double-buffering off, so one window yields the pipelined-vs-serial
     # delta (VERDICT r3 item 2b) without a second window.
-    extra_env = {}
-    bench_config = config
-    if config == "bridge_serial":
-        bench_config = "bridge"
-        extra_env["RESERVOIR_BENCH_BRIDGE_PIPELINED"] = "0"
+    extra_env = dict(extra_env or {})
+    if bench_config is None:
+        bench_config = config
+        if config == "bridge_serial":
+            bench_config = "bridge"
+            extra_env["RESERVOIR_BENCH_BRIDGE_PIPELINED"] = "0"
     env = dict(os.environ, RESERVOIR_BENCH_CONFIG=bench_config, **extra_env)
     t0 = time.time()
     try:
@@ -199,6 +209,14 @@ POST_STEPS: list[tuple[str, list[str], float, dict]] = [
         1800.0,
         {"RESERVOIR_TPU_TEST_PLATFORM": "native"},
     ),
+    (
+        # after the sweep: if a block beats 64, re-capture the headline at
+        # it — one window yields both the sweep AND its winner's number
+        "algl_best_block",
+        [sys.executable, os.path.join(REPO, "tools", "tpu_algl_best_block.py")],
+        2700.0,
+        {},
+    ),
 ]
 
 
@@ -211,6 +229,10 @@ def main() -> int:
         help="comma-separated bench configs to capture when the window opens",
     )
     args = ap.parse_args()
+    # post steps inherit the run-start stamp so consumers of append-only
+    # artifacts (best-block over the sweep file) can ignore records from
+    # earlier rounds/runs
+    os.environ["TPU_WATCH_RUN_START"] = _now()
     deadline = time.time() + args.max_hours * 3600
     attempt = 0
     # Per-config tracking: a config captured in one window is never re-run
@@ -242,11 +264,16 @@ def main() -> int:
                     break
             remaining = still
             if not dropped:
-                post_remaining = [
-                    step
-                    for step in post_remaining
-                    if not _run_post_step(step[0], step[1], step[2], step[3])
-                ]
+                # SEQUENTIAL gating: a later step may depend on an earlier
+                # one's output (best-block reads the sweep's file), so the
+                # first failure keeps itself AND everything after it for
+                # the next window
+                done_upto = 0
+                for step in post_remaining:
+                    if not _run_post_step(step[0], step[1], step[2], step[3]):
+                        break
+                    done_upto += 1
+                post_remaining = post_remaining[done_upto:]
             if not remaining and not post_remaining:
                 print(f"[{_now()}] capture complete", flush=True)
                 return 0
