@@ -4,21 +4,23 @@ The interpret-mode suites pin the *algorithms*; the three Mosaic-only
 lowering bugs found in round 2 (BENCH.md) proved the CPU interpreter hides
 real failure modes.  ``tests/test_pallas_device.py`` covers hardware but is
 device-gated — skipped in CI and absent from driver artifacts.  This module
-packages the same three bit-equality checks as a cheap callable so the
-bench artifact itself can prove ``pallas == xla`` on the chip: ``bench.py``
+packages the same bit-equality checks (four of them) as a cheap callable so
+the bench artifact itself can prove ``pallas == xla`` on the chip: ``bench.py``
 embeds the result dict into its one JSON line, and
 ``__graft_entry__.device_selftest()`` exposes it to the driver directly.
 
 Checks:
-  - algl:     steady-state tile update, int32 samples
-  - distinct: bottom-k insert/shift over duplicated keys, 3 chained steps
-  - weighted: A-ExpJ accept/evict with zero-weight lanes
+  - algl:      steady-state tile update, int32 samples
+  - algl_fill: fill + fill-completing tiles through the fill-capable
+               kernel (r4: impl='pallas' covers the whole life cycle)
+  - distinct:  bottom-k insert/shift over duplicated keys, 3 chained steps
+  - weighted:  A-ExpJ accept/evict with zero-weight lanes
 
 Each check compares every leaf of the resulting state pytrees with
 bit-exact ``array_equal``.  Shapes are backend-dependent: on TPU the
 production block sizes (R=64 rows x B=256, Mosaic-compiled, a few seconds
 each); on the CPU *interpreter* the same shapes take many MINUTES (measured
->15 min for the trio), so CPU runs shrink to the interpret-suite shapes
+>15 min for the original trio), so CPU runs shrink to the interpret-suite shapes
 (R=8, B=64) — still the same trace, still bit-exact, just sized for the
 interpreter.  Callers that must never hang (driver entry points, bench)
 run this in a subprocess with a hard timeout — see
@@ -76,6 +78,34 @@ def _check_algl(interpret: bool) -> bool:
     return _leaves_equal(ref, got)
 
 
+def _check_algl_fill(interpret: bool) -> bool:
+    """The fill-capable kernel across the life-cycle boundary (r4:
+    impl='pallas' covers fill): ``k`` is chosen in ``(B, 2B)`` so tile 1
+    is a pure fill and tile 2 ENTERS with ``0 < count < k`` — exercising
+    the count-offset fill scatter (``dest = count + lane``) — and
+    completes the fill MID-tile, with steady accepts in the same tile.
+    Bit-equal to the XLA ``update`` chain after each tile."""
+    import jax
+    import jax.numpy as jnp
+    import jax.random as jr
+
+    from ..ops import algorithm_l as al
+    from ..ops import algorithm_l_pallas as alp
+
+    R, block_r, B = _shapes(interpret)
+    k = 384 if not interpret else 96  # B < k < 2B: boundary mid-tile 2
+    ref = pal = al.init(jr.key(8), R, k)
+    for t in range(2):
+        batch = 1 + t * B + jax.lax.broadcasted_iota(jnp.int32, (R, B), 1)
+        ref = al.update(ref, batch)
+        pal = alp.update_pallas(
+            pal, batch, block_r=block_r, interpret=interpret
+        )
+        if not _leaves_equal(ref, pal):
+            return False
+    return True
+
+
 def _check_distinct(interpret: bool) -> bool:
     import jax.numpy as jnp
     import jax.random as jr
@@ -123,12 +153,13 @@ def _check_weighted(interpret: bool) -> bool:
 
 
 def device_selftest() -> Dict[str, Any]:
-    """Run all three parity checks on the live backend.
+    """Run every parity check on the live backend.
 
-    Returns ``{"platform": ..., "algl": bool, "distinct": bool,
-    "weighted": bool, "pallas_parity": bool, ["<name>_error": str]}`` —
-    never raises; a crash in any check is recorded as failure with the
-    message under its own ``<name>_error`` key.
+    Returns ``{"platform": ..., "algl": bool, "algl_fill": bool,
+    "distinct": bool, "weighted": bool, "pallas_parity": bool,
+    ["<name>_error": str]}`` — never raises; a crash in any check is
+    recorded as failure with the message under its own ``<name>_error``
+    key.
     """
     import jax
 
@@ -138,6 +169,7 @@ def device_selftest() -> Dict[str, Any]:
     ok = True
     for name, fn in (
         ("algl", _check_algl),
+        ("algl_fill", _check_algl_fill),
         ("distinct", _check_distinct),
         ("weighted", _check_weighted),
     ):
