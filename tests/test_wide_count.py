@@ -237,3 +237,51 @@ class TestWideEngine:
                 ),
                 key=0,
             )
+
+
+class TestWideMergeInt64Parity:
+    def test_wide_merge_picks_bit_identical_to_int64(self):
+        # The wide merge's emulated 64-bit rejection sampler consumes the
+        # SAME Threefry blocks under the SAME accept rule as the x64 int64
+        # path, so for equal counts and key the hypergeometric scan must
+        # take identical per-row counts from A at any magnitude.  (The
+        # subset *permutation* draws differ under x64 — jr.uniform
+        # defaults to f64 there — so membership counts, not slot-for-slot
+        # samples, are the bit-level invariant.)
+        rng = np.random.default_rng(77)
+        R, k = 256, 16
+        counts_a = rng.integers(1, 1 << 40, R)
+        counts_b = rng.integers(1, 1 << 40, R)
+        # a few boundary rows: tiny counts, equal counts, 2^32 straddles
+        counts_a[:4] = [1, 3, (1 << 32) - 1, (1 << 32) + 1]
+        counts_b[:4] = [2, 3, (1 << 32) + 5, (1 << 32) - 3]
+        s_a = jnp.tile(1 + jnp.arange(k, dtype=jnp.int32), (R, 1))
+        s_b = jnp.tile(1_000_000 + jnp.arange(k, dtype=jnp.int32), (R, 1))
+        key = jr.key(78)
+
+        c_a_w = u64e.make(
+            jnp.asarray(counts_a & 0xFFFFFFFF, jnp.uint32),
+            jnp.asarray(counts_a >> 32, jnp.uint32),
+        )
+        c_b_w = u64e.make(
+            jnp.asarray(counts_b & 0xFFFFFFFF, jnp.uint32),
+            jnp.asarray(counts_b >> 32, jnp.uint32),
+        )
+        sw, cw = al.merge_samples(s_a, c_a_w, s_b, c_b_w, key)
+        from_a_wide = (np.asarray(sw) > 0) & (np.asarray(sw) < 1_000_000)
+
+        with jax.enable_x64(True):
+            si, ci = al.merge_samples(
+                s_a, jnp.asarray(counts_a, jnp.int64),
+                s_b, jnp.asarray(counts_b, jnp.int64), key,
+            )
+        from_a_int64 = (np.asarray(si) > 0) & (np.asarray(si) < 1_000_000)
+
+        np.testing.assert_array_equal(
+            from_a_wide.sum(axis=1), from_a_int64.sum(axis=1)
+        )
+        # totals agree exactly at 64-bit magnitude
+        got = (np.asarray(cw)[:, 1].astype(np.uint64) << np.uint64(32)) | (
+            np.asarray(cw)[:, 0].astype(np.uint64)
+        )
+        np.testing.assert_array_equal(got, np.asarray(ci).astype(np.uint64))
