@@ -170,3 +170,37 @@ def test_weighted_mode_skew_matches_naive_oracle():
         f"heavy-class inclusion: device {dev_heavy:.4f} vs "
         f"oracle {cpu_heavy:.4f} (5 sigma = {5 * sigma:.4f})"
     )
+
+
+def test_bridge_path_within_1pct_ks_of_uniform():
+    # The BASELINE config-5 clause measures the feed path, not just the
+    # kernel: this gates the BRIDGE half (interleaved demux -> staging ->
+    # ragged-valid device flushes) — an interleaved multi-stream feed must
+    # leave every stream's sample uniform over its own substream.  (The
+    # operator half's pass-through/completion semantics are pinned by
+    # tests/test_stream.py.)
+    # Pool S*k = 65,536 draws: null 95th pct ~ 1.36/sqrt(N) ~ 0.0053, so
+    # the 1% gate sits ~1.9x above the null scale.
+    from reservoir_tpu import SamplerConfig
+    from reservoir_tpu.stream.bridge import DeviceStreamBridge
+
+    S, k, B, n = 1024, 64, 128, 2000
+    rng = np.random.default_rng(123)
+    ids = np.repeat(np.arange(S, dtype=np.int32), n)
+    rng.shuffle(ids)
+    # stream s's j-th element (in arrival order) carries value j
+    values = np.empty(S * n, np.int32)
+    values[np.argsort(ids, kind="stable")] = np.tile(
+        np.arange(n, dtype=np.int32), S
+    )
+    bridge = DeviceStreamBridge(
+        SamplerConfig(max_sample_size=k, num_reservoirs=S, tile_size=B),
+        key=42,
+    )
+    bridge.push_interleaved(ids, values)
+    res = bridge.complete()
+    pooled = np.concatenate(res)
+    assert pooled.shape == (S * k,)
+    assert pooled.min() >= 0 and pooled.max() < n
+    d = _ks_one_sample_uniform(pooled, n)
+    assert d < GATE, f"bridge-path KS {d:.4f} exceeds the 1% gate"

@@ -23,6 +23,15 @@ from reservoir_tpu.ops import algorithm_l as al
 from reservoir_tpu.ops import u64e
 
 
+def _counts_to_planes(counts: np.ndarray):
+    """Host int array -> wide (lo, hi) planes via the layout's single owner."""
+    c = np.asarray(counts).astype(np.uint64)
+    return u64e.make(
+        jnp.asarray(c & np.uint64(0xFFFFFFFF), jnp.uint32),
+        jnp.asarray(c >> np.uint64(32), jnp.uint32),
+    )
+
+
 def _lift_wide(state32, shift: int):
     """Re-base an int32-count state to absolute position ``count + shift``
     as a WIDE state (same samples/log_w/key; count/nxt shifted)."""
@@ -259,14 +268,8 @@ class TestWideMergeInt64Parity:
         s_b = jnp.tile(1_000_000 + jnp.arange(k, dtype=jnp.int32), (R, 1))
         key = jr.key(78)
 
-        c_a_w = u64e.make(
-            jnp.asarray(counts_a & 0xFFFFFFFF, jnp.uint32),
-            jnp.asarray(counts_a >> 32, jnp.uint32),
-        )
-        c_b_w = u64e.make(
-            jnp.asarray(counts_b & 0xFFFFFFFF, jnp.uint32),
-            jnp.asarray(counts_b >> 32, jnp.uint32),
-        )
+        c_a_w = _counts_to_planes(counts_a)
+        c_b_w = _counts_to_planes(counts_b)
         sw, cw = al.merge_samples(s_a, c_a_w, s_b, c_b_w, key)
         from_a_wide = (np.asarray(sw) > 0) & (np.asarray(sw) < 1_000_000)
 
@@ -281,7 +284,7 @@ class TestWideMergeInt64Parity:
             from_a_wide.sum(axis=1), from_a_int64.sum(axis=1)
         )
         # totals agree exactly at 64-bit magnitude
-        got = (np.asarray(cw)[:, 1].astype(np.uint64) << np.uint64(32)) | (
-            np.asarray(cw)[:, 0].astype(np.uint64)
-        )
+        got = (
+            np.asarray(u64e.hi(cw)).astype(np.uint64) << np.uint64(32)
+        ) | np.asarray(u64e.lo(cw)).astype(np.uint64)
         np.testing.assert_array_equal(got, np.asarray(ci).astype(np.uint64))
