@@ -86,12 +86,9 @@ def _probe_backend_proc(timeout_s: float):
     return probe_backend_proc(timeout_s)
 
 
-def _probe_backend(timeout_s: float) -> bool:
-    return _probe_backend_proc(timeout_s) is not None
-
-
 def _init_backend_with_retry(
-    attempts: int = 7, first_delay_s: float = 5.0, probe_timeout_s: float = 60.0
+    attempts: int = 7, first_delay_s: float = 5.0, probe_timeout_s: float = 60.0,
+    pre_init_hook=None,
 ) -> str:
     """Touch the backend, retrying transient tunnel failures.
 
@@ -101,14 +98,44 @@ def _init_backend_with_retry(
     liveness in a subprocess (hang-proof), then initializes in-process only
     once a probe has succeeded.  Exponential backoff capped at 90s between
     attempts (~11 min worst case incl. hung probes) — then a fast, clearly
-    worded exit, never an in-process init that can hang."""
+    worded exit, never an in-process init that can hang.
+
+    ``pre_init_hook(platform: str)``: called at most once, after the first
+    successful probe and BEFORE the in-process ``jax.devices()``.  This is
+    the only window in the bench's lifetime where the backend is known
+    alive and no process holds the one tunnel client slot — subprocess
+    work that needs the device to itself (the Pallas parity selftest)
+    must happen here, not after the timed run (r4: the post-run selftest
+    always found the client slot occupied by the bench itself)."""
     if os.environ.get("RESERVOIR_BENCH_PLATFORM"):
         # explicitly pinned platform (e.g. cpu): init cannot hang, and the
-        # probe subprocess would touch the *default* backend instead
+        # probe subprocess would touch the *default* backend instead.
+        # The hook still runs first — on a pinned real device (direct-
+        # attached chip) the selftest child needs the device before this
+        # process claims it, same as the tunneled path.
+        if pre_init_hook is not None:
+            pre_init_hook(os.environ["RESERVOIR_BENCH_PLATFORM"])
         return jax.devices()[0].platform
     delay = first_delay_s
     for attempt in range(attempts):
-        if _probe_backend(probe_timeout_s):
+        probed = _probe_backend_proc(probe_timeout_s)
+        if probed is not None:
+            if pre_init_hook is not None:
+                try:
+                    pre_init_hook(probed)
+                finally:
+                    pre_init_hook = None  # at most once, even on retry
+                # the hook can run for many minutes (full on-chip parity
+                # sweep): the probe that green-lit this attempt is stale,
+                # and an in-process init against a tunnel that died mid-
+                # hook HANGS (the documented outage mode) rather than
+                # raising.  Re-probe before committing to init.
+                if _probe_backend_proc(probe_timeout_s) is None:
+                    print(
+                        "bench: backend lost during pre-init hook; retrying",
+                        file=sys.stderr,
+                    )
+                    continue
             try:
                 devices = jax.devices()  # probe succeeded; init for real
                 return devices[0].platform
@@ -453,11 +480,41 @@ def main() -> None:
     reps = int(os.environ.get("RESERVOIR_BENCH_REPS", 3))
 
     tag_suffix = ""
+    # On-chip pallas==xla parity, embedded in the artifact (VERDICT r2
+    # item 2).  Runs as a pre-init hook: the tunneled backend admits one
+    # client at a time, so the selftest child gets the device in the gap
+    # between the liveness probe and the bench's own backend init.
+    # Defaults to the headline config only — a multi-config capture
+    # sweep re-proving parity per config would burn scarce hardware-
+    # window time the device test suite already covers.
+    selftest_default = "1" if config == "algl" else "0"
+    run_selftest = (
+        os.environ.get("RESERVOIR_BENCH_SELFTEST", selftest_default) == "1"
+    )
+    selftest_result: dict = {}
+
+    def _selftest_pre_init(probed_platform: str) -> None:
+        if probed_platform != "tpu" or not run_selftest:
+            return
+        from reservoir_tpu.utils.selftest import device_selftest_subprocess
+
+        print("bench: running on-chip parity selftest", file=sys.stderr)
+        selftest_result.update(
+            device_selftest_subprocess(timeout_s=900.0, skip_probe=True)
+        )
+        print(
+            f"bench: selftest pallas_parity="
+            f"{selftest_result.get('pallas_parity')}",
+            file=sys.stderr,
+        )
+
     if config == "host":
         platform = "cpu-host"  # pure host path; never touch the backend
     else:
         try:
-            platform = _init_backend_with_retry()
+            platform = _init_backend_with_retry(
+                pre_init_hook=_selftest_pre_init
+            )
         except SystemExit as e:
             # The device backend is unreachable after ~11 min of probing.
             # A round must still record SOME honest number (VERDICT r1:
@@ -535,26 +592,16 @@ def main() -> None:
     }
     if config == "bridge":
         record["stages"] = bridge_stages
-    if (
-        platform == "tpu"
-        and os.environ.get("RESERVOIR_BENCH_SELFTEST", "1") == "1"
-    ):
-        # Embed on-chip pallas==xla bit-equality into the artifact itself
-        # (VERDICT r2 item 2): the device-gated parity suite never reaches
-        # driver artifacts, so the bench line carries the proof.  Runs in a
-        # subprocess with a hard timeout — a tunnel drop or Mosaic hang
-        # during the selftest must cost minutes, not erase the number that
-        # was just measured.
-        from reservoir_tpu.utils.selftest import device_selftest_subprocess
-
-        try:
-            # release the TPU client first: standard libtpu allows ONE
-            # process on the chip, and the selftest child must init its own
-            # backend (timed work is done — nothing left to lose here)
-            jax.extend.backend.clear_backends()
-        except Exception as e:
-            print(f"bench: clear_backends before selftest: {e}", file=sys.stderr)
-        st = device_selftest_subprocess(timeout_s=900.0)
+    if run_selftest and (platform == "tpu" or selftest_result):
+        # The parity result was captured by the pre-init hook (the only
+        # window where the selftest child can hold the tunnel's one
+        # client slot); embed it into the artifact line here.  A result
+        # is kept even if the timed run then fell back to the host — the
+        # parity evidence cost real hardware-window time and stands on
+        # its own (its 'platform' key says where it ran).
+        st = dict(selftest_result) or {
+            "error": "selftest hook never ran (backend init path)"
+        }
         record["pallas_parity"] = st.pop("pallas_parity", False)
         record["selftest"] = st
     print(json.dumps(record))
