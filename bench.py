@@ -112,15 +112,21 @@ def _init_backend_with_retry(
         # probe subprocess would touch the *default* backend instead.
         # The hook still runs first — on a pinned real device (direct-
         # attached chip) the selftest child needs the device before this
-        # process claims it, same as the tunneled path.
+        # process claims it, same as the tunneled path.  probed=False: no
+        # liveness probe ran on this path, so the hook must do its own
+        # (the child's probe hits the default backend, which is the
+        # pinned one when env and default agree — the supported case).
         if pre_init_hook is not None:
-            pre_init_hook(os.environ["RESERVOIR_BENCH_PLATFORM"])
+            pre_init_hook(
+                os.environ["RESERVOIR_BENCH_PLATFORM"], probed=False
+            )
         return jax.devices()[0].platform
     delay = first_delay_s
     for attempt in range(attempts):
         probed = _probe_backend_proc(probe_timeout_s)
         if probed is not None:
             if pre_init_hook is not None:
+                hook_t0 = time.time()
                 try:
                     pre_init_hook(probed)
                 finally:
@@ -129,8 +135,12 @@ def _init_backend_with_retry(
                 # sweep): the probe that green-lit this attempt is stale,
                 # and an in-process init against a tunnel that died mid-
                 # hook HANGS (the documented outage mode) rather than
-                # raising.  Re-probe before committing to init.
-                if _probe_backend_proc(probe_timeout_s) is None:
+                # raising.  Re-probe before committing to init — but only
+                # when the hook actually spent time (a no-op hook leaves
+                # the original probe fresh; don't tax every run ~20s).
+                if time.time() - hook_t0 > 10.0 and (
+                    _probe_backend_proc(probe_timeout_s) is None
+                ):
                     print(
                         "bench: backend lost during pre-init hook; retrying",
                         file=sys.stderr,
@@ -493,14 +503,16 @@ def main() -> None:
     )
     selftest_result: dict = {}
 
-    def _selftest_pre_init(probed_platform: str) -> None:
-        if probed_platform != "tpu" or not run_selftest:
+    def _selftest_pre_init(probed_platform: str, probed: bool = True) -> None:
+        # "tpu,cpu" is valid jax_platforms comma-priority syntax on the
+        # pinned path; the first entry is the backend that will serve
+        if probed_platform.split(",")[0] != "tpu" or not run_selftest:
             return
         from reservoir_tpu.utils.selftest import device_selftest_subprocess
 
         print("bench: running on-chip parity selftest", file=sys.stderr)
         selftest_result.update(
-            device_selftest_subprocess(timeout_s=900.0, skip_probe=True)
+            device_selftest_subprocess(timeout_s=900.0, skip_probe=probed)
         )
         print(
             f"bench: selftest pallas_parity="

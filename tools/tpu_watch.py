@@ -40,10 +40,11 @@ def _now() -> str:
 def probe() -> str | None:
     """Return the backend platform string, or None if unreachable/hung.
 
-    Probes in a throwaway subprocess via bench.py's own ``_probe_backend``
-    snippet — ONE copy of the backend-liveness contract, so a tweak to the
-    probe (new tunnel failure mode) can't leave the watcher declaring UP a
-    backend bench.py then can't use.
+    Probes in a throwaway subprocess via bench.py's ``_probe_backend_proc``
+    (itself a thin re-export of ``reservoir_tpu.utils.probe``) — ONE copy
+    of the backend-liveness contract, so a tweak to the probe (new tunnel
+    failure mode) can't leave the watcher declaring UP a backend bench.py
+    then can't use.
     """
     if REPO not in sys.path:
         # stays on the path: bench's probe helper lazily imports
