@@ -100,8 +100,11 @@ def _init_backend_with_retry(
     attempts (~11 min worst case incl. hung probes) — then a fast, clearly
     worded exit, never an in-process init that can hang.
 
-    ``pre_init_hook(platform: str)``: called at most once, after the first
-    successful probe and BEFORE the in-process ``jax.devices()``.  This is
+    ``pre_init_hook(platform: str, probed: bool = True)``: called at most
+    once, BEFORE the in-process ``jax.devices()`` — with ``probed=True``
+    after the first successful probe on the tunneled path, or
+    ``probed=False`` on the pinned-platform path where no liveness probe
+    ran (the hook must then do its own).  This is
     the only window in the bench's lifetime where the backend is known
     alive and no process holds the one tunnel client slot — subprocess
     work that needs the device to itself (the Pallas parity selftest)

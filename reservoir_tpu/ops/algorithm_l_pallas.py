@@ -30,6 +30,7 @@ so they take zero acceptance rounds) and sliced off after.
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -49,7 +50,10 @@ _DEFAULT_BLOCK_R = 64
 # 128 compiled >6 min); fixed-width chunks keep each op's vreg footprint
 # constant as block_r/B grow.  Integer sums over disjoint chunks stay
 # exact, so bit-equivalence with the XLA path is unaffected.
-_GATHER_CHUNK_B = 512
+# RESERVOIR_ALGL_CHUNK_B overrides (0 = full-width gathers, the pre-r4
+# shape) so a hardware window can A/B the chunking's runtime cost at the
+# proven block sizes — it exists for compile-time control, not speed.
+_GATHER_CHUNK_B = int(os.environ.get("RESERVOIR_ALGL_CHUNK_B", "512"))
 
 
 def pick_block_r(num_reservoirs: int, k: int, tile_b: int) -> int:
@@ -104,7 +108,7 @@ def _kernel(samples_ref, count_ref, nxt_ref, logw_ref, key_ref, batch_ref,
     k2 = key_ref[:, 1:2]
     block_r = count.shape[0]
 
-    chunk_b = min(block_b, _GATHER_CHUNK_B)
+    chunk_b = min(block_b, _GATHER_CHUNK_B) if _GATHER_CHUNK_B else block_b
     if block_b % chunk_b != 0:  # odd widths: one full-width gather
         chunk_b = block_b
     n_chunks = block_b // chunk_b

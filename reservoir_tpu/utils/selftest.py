@@ -152,14 +152,53 @@ def _check_weighted(interpret: bool) -> bool:
     return _leaves_equal(ref, got)
 
 
+def _check_ks(interpret: bool):
+    """On-backend statistical-quality gate: pooled one-sample KS of the
+    device sampler's output against the exact uniform law, at the literal
+    BASELINE 1% gate (``tests/test_ks_gate.py`` is the CPU-CI twin; this
+    copy runs on whatever backend serves the selftest so the bench
+    artifact carries the gate from real hardware).  Pool N = R*k =
+    131,072 puts the null 95th percentile ~2.7x below the gate
+    (false-fail ~1e-11).  Combined with the bit-parity checks above, the
+    gate covers the Pallas kernels transitively.
+
+    Returns ``(ks_distance, ok)``.
+    """
+    import jax
+    import jax.numpy as jnp
+    import jax.random as jr
+    import numpy as np
+
+    from ..ops import algorithm_l as al
+    from .stats import ks_one_sample_uniform
+
+    # Same shapes on every backend: the check is plain XLA (fast even on
+    # CPU — the interpreter shrink only matters for Pallas checks), and a
+    # smaller pool would put the null KS scale ABOVE the 1% gate.
+    del interpret
+    R, k, n, B = 2048, 64, 8192, 512
+    state = al.init(jr.key(0), R, k)
+    fn = jax.jit(al.update, donate_argnums=0)
+    for start in range(0, n, B):
+        batch = start + jax.lax.broadcasted_iota(jnp.int32, (R, B), 1)
+        state = fn(state, batch)
+    samples, sizes = al.result(state)
+    assert int(np.asarray(sizes).min()) == k
+    ks = ks_one_sample_uniform(np.asarray(samples).ravel(), n)
+    return ks, ks < 0.01
+
+
 def device_selftest() -> Dict[str, Any]:
     """Run every parity check on the live backend.
 
     Returns ``{"platform": ..., "algl": bool, "algl_fill": bool,
     "distinct": bool, "weighted": bool, "pallas_parity": bool,
-    ["<name>_error": str]}`` — never raises; a crash in any check is
-    recorded as failure with the message under its own ``<name>_error``
-    key.
+    "ks_ok": bool, ["ks_uniform": float], ["<name>_error": str],
+    ["ks_error": str]}`` — never raises; a crash in any check is recorded
+    as failure with the message under its own ``*_error`` key
+    (``ks_uniform`` is absent when the KS check itself crashed).
+    ``pallas_parity`` is strictly the AND of the bit-equality checks; the
+    KS gate reports separately.
     """
     import jax
 
@@ -180,6 +219,11 @@ def device_selftest() -> Dict[str, Any]:
             out[f"{name}_error"] = f"{type(e).__name__}: {e}"[:500]
         ok = ok and out[name]
     out["pallas_parity"] = ok
+    try:
+        out["ks_uniform"], out["ks_ok"] = _check_ks(interpret)
+    except Exception as e:
+        out["ks_ok"] = False
+        out["ks_error"] = f"{type(e).__name__}: {e}"[:500]
     return out
 
 

@@ -1,0 +1,28 @@
+"""Statistical-gate helpers shared by CI tests and the on-backend selftest.
+
+THE one copy of the BASELINE 1% KS-gate formula (the convention
+:mod:`.probe` establishes for the backend-liveness contract): the CI twin
+``tests/test_ks_gate.py`` and the bench-embedded selftest
+(:mod:`.selftest`) both import from here, so the gate a driver artifact
+reports is by construction the gate CI enforces.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["ks_one_sample_uniform"]
+
+
+def ks_one_sample_uniform(values: np.ndarray, n: int) -> float:
+    """``sup_x |ECDF(x) - x/n|`` for values drawn from ``{0..n-1}``.
+
+    The exact one-sample Kolmogorov-Smirnov statistic against the discrete
+    uniform law on an ``n``-element ordered stream (the discrete-grid bias
+    is ``<= 1/n``, negligible at the pool sizes the gates use).
+    """
+    s = np.sort(np.asarray(values)) / float(n)
+    m = len(s)
+    ecdf_hi = np.arange(1, m + 1) / m
+    ecdf_lo = np.arange(0, m) / m
+    return float(np.maximum(np.abs(ecdf_hi - s), np.abs(s - ecdf_lo)).max())

@@ -23,17 +23,14 @@ import numpy as np
 
 from reservoir_tpu.oracle.algorithm_l import AlgorithmLOracle
 from reservoir_tpu.ops import algorithm_l as al
+from reservoir_tpu.utils.stats import ks_one_sample_uniform
 
 GATE = 0.01  # the BASELINE "within 1% KS-distance" gate
 
-
-def _ks_one_sample_uniform(values: np.ndarray, n: int) -> float:
-    """sup_x |ECDF(x) - x/n| for values drawn from {0..n-1}."""
-    s = np.sort(values) / float(n)
-    m = len(s)
-    ecdf_hi = np.arange(1, m + 1) / m
-    ecdf_lo = np.arange(0, m) / m
-    return float(np.maximum(np.abs(ecdf_hi - s), np.abs(s - ecdf_lo)).max())
+# one copy of the gate formula, shared with the on-backend selftest
+# (reservoir_tpu/utils/stats.py) so CI and driver artifacts enforce the
+# same contract
+_ks_one_sample_uniform = ks_one_sample_uniform
 
 
 def _ks_two_sample(a: np.ndarray, b: np.ndarray) -> float:
