@@ -73,6 +73,7 @@ import jax.random as jr
 import numpy as np
 
 NORTH_STAR = 1e9  # elem/s (BASELINE.md)
+_REPO = os.path.dirname(os.path.abspath(__file__))
 
 
 def _probe_backend_proc(timeout_s: float):
@@ -547,6 +548,50 @@ def main() -> None:
             tag_suffix = "_fallback_backend_unreachable"
     print(f"bench: backend ready ({platform})", file=sys.stderr)
 
+    def _last_captured_tpu_row():
+        """Most recent TPU-platform row from the round-spanning watcher's
+        committed capture files (``TPU_CAPTURE_r*.jsonl``).
+
+        A tunnel outage at the moment the driver runs the bench erased
+        rounds 1-3's hardware evidence even when the chip had been
+        benched hours earlier in the same round.  The fallback record
+        therefore carries a pointer to the latest captured on-chip row —
+        clearly labeled with its own timestamp and config, never blended
+        into the fallback's measured value.
+        """
+        import glob
+
+        best = None
+        for path in sorted(glob.glob(os.path.join(_REPO, "TPU_CAPTURE_r*.jsonl"))):
+            try:
+                with open(path) as f:
+                    for line in f:
+                        try:
+                            rec = json.loads(line)
+                        except json.JSONDecodeError:
+                            continue
+                        res = rec.get("result") or {}
+                        if (
+                            res.get("platform") == "tpu"
+                            and rec.get("config") == "algl"
+                            and isinstance(res.get("value"), (int, float))
+                        ):
+                            best = {
+                                "ts": rec.get("ts"),
+                                "metric": res.get("metric"),
+                                "value": res.get("value"),
+                                "median": res.get("median"),
+                                "vs_baseline": res.get("vs_baseline"),
+                                "pallas_parity": res.get("pallas_parity"),
+                                "ks_ok": (res.get("selftest") or {}).get(
+                                    "ks_ok"
+                                ),
+                                "source": os.path.basename(path),
+                            }
+            except OSError:
+                pass
+        return best
+
     from reservoir_tpu.utils.tracing import maybe_profile
 
     with maybe_profile():  # RESERVOIR_TPU_TRACE_DIR=... captures a trace
@@ -619,6 +664,10 @@ def main() -> None:
         }
         record["pallas_parity"] = st.pop("pallas_parity", False)
         record["selftest"] = st
+    if tag_suffix:  # backend-unreachable fallback: point at committed
+        captured = _last_captured_tpu_row()  # evidence from this round
+        if captured is not None:
+            record["last_captured_tpu"] = captured
     print(json.dumps(record))
 
 

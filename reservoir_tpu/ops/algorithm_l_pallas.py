@@ -108,7 +108,7 @@ def _kernel(samples_ref, count_ref, nxt_ref, logw_ref, key_ref, batch_ref,
     k2 = key_ref[:, 1:2]
     block_r = count.shape[0]
 
-    chunk_b = min(block_b, _GATHER_CHUNK_B) if _GATHER_CHUNK_B else block_b
+    chunk_b = min(block_b, _GATHER_CHUNK_B) if _GATHER_CHUNK_B > 0 else block_b
     if block_b % chunk_b != 0:  # odd widths: one full-width gather
         chunk_b = block_b
     n_chunks = block_b // chunk_b

@@ -170,7 +170,7 @@ def _check_ks(interpret: bool):
     import numpy as np
 
     from ..ops import algorithm_l as al
-    from .stats import ks_one_sample_uniform
+    from .stats import KS_GATE, ks_one_sample_uniform
 
     # Same shapes on every backend: the check is plain XLA (fast even on
     # CPU — the interpreter shrink only matters for Pallas checks), and a
@@ -185,7 +185,7 @@ def _check_ks(interpret: bool):
     samples, sizes = al.result(state)
     assert int(np.asarray(sizes).min()) == k
     ks = ks_one_sample_uniform(np.asarray(samples).ravel(), n)
-    return ks, ks < 0.01
+    return ks, ks < KS_GATE
 
 
 def device_selftest() -> Dict[str, Any]:

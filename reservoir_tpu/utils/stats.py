@@ -11,7 +11,10 @@ from __future__ import annotations
 
 import numpy as np
 
-__all__ = ["ks_one_sample_uniform"]
+__all__ = ["KS_GATE", "ks_one_sample_uniform"]
+
+#: the literal BASELINE "within 1% KS-distance" acceptance gate
+KS_GATE = 0.01
 
 
 def ks_one_sample_uniform(values: np.ndarray, n: int) -> float:

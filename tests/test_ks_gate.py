@@ -23,9 +23,9 @@ import numpy as np
 
 from reservoir_tpu.oracle.algorithm_l import AlgorithmLOracle
 from reservoir_tpu.ops import algorithm_l as al
-from reservoir_tpu.utils.stats import ks_one_sample_uniform
+from reservoir_tpu.utils.stats import KS_GATE, ks_one_sample_uniform
 
-GATE = 0.01  # the BASELINE "within 1% KS-distance" gate
+GATE = KS_GATE  # the BASELINE "within 1% KS-distance" gate (one copy)
 
 # one copy of the gate formula, shared with the on-backend selftest
 # (reservoir_tpu/utils/stats.py) so CI and driver artifacts enforce the
