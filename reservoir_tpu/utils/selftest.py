@@ -35,8 +35,23 @@ __all__ = ["device_selftest", "device_selftest_subprocess"]
 
 
 def _shapes(interpret: bool):
-    """(R, block_r, B) — production blocks on hardware, tiny on interpreter."""
-    return (8, 8, 64) if interpret else (64, 64, 256)
+    """(R, block_r, B) — production blocks on hardware, tiny on interpreter.
+
+    On hardware the algl row-block follows ``RESERVOIR_BENCH_BLOCK_R``
+    (the bench's own knob) so a selftest embedded in a non-default-block
+    capture — e.g. the sweep winner's re-capture — proves parity at the
+    exact kernel shape that produced the number."""
+    import os
+
+    if interpret:
+        return (8, 8, 64)
+    try:
+        block_r = int(os.environ.get("RESERVOIR_BENCH_BLOCK_R", 64))
+    except ValueError:
+        block_r = 64
+    if block_r <= 0:  # 0 = the bench's auto-pick sentinel
+        block_r = 64
+    return (max(8, block_r), max(8, block_r), 256)
 
 
 def _leaves_equal(a, b) -> bool:

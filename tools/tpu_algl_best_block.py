@@ -1,15 +1,17 @@
-"""Re-capture the headline algl bench at the best swept block size.
+"""Re-capture the headline algl bench at the best swept (block, chunk).
 
 Runs as the watcher's final post-step (sequentially gated: only after
-``tpu_algl_block_sweep.py`` completed this run), reading the per-block
+``tpu_algl_block_sweep.py`` completed this run), reading the per-variant
 compile/throughput records it appended to ``TPU_BLOCK_SWEEP.jsonl``:
-pick the block with the highest steady-state throughput among blocks
-that compiled sanely (compile+first-run under ``--max-compile-s``),
-and — if it beats the default block 64 — run one more ``bench.py`` algl
-capture with ``RESERVOIR_BENCH_BLOCK_R`` set, via the watcher's own
-``capture_bench`` (same timeout-salvage, same capture file).  This turns
-one hardware window into both the sweep evidence AND a headline number
-at the sweep's winner (VERDICT r3 item 2a), with no second window.
+pick the (block_r, chunk_b) variant with the highest steady-state
+throughput among variants that compiled sanely (compile+first-run under
+``--max-compile-s``), and — if it differs from the bench default
+(block 64, chunk 512) — run one more ``bench.py`` algl capture with
+``RESERVOIR_BENCH_BLOCK_R``/``RESERVOIR_ALGL_CHUNK_B`` set, via the
+watcher's own ``capture_bench`` (same timeout-salvage, same capture
+file).  This turns one hardware window into both the sweep evidence AND
+a headline number at the sweep's winner (VERDICT r3 item 2a), with no
+second window.
 
 Only records stamped at/after ``--since`` (default: the watcher's
 ``TPU_WATCH_RUN_START`` env) count — the sweep file is append-only
@@ -17,8 +19,9 @@ across rounds, and a stale record from an older kernel must never pick
 the winner.
 
 Exit 0 when there is genuinely nothing to do (this run's sweep found no
-block beating 64); exit 1 when the sweep has not produced usable data
-yet, so the sequentially-gated watcher retries both next window.
+variant beating the default); exit 1 when the sweep has not produced
+usable data yet, so the sequentially-gated watcher retries both next
+window.
 """
 
 from __future__ import annotations
@@ -30,17 +33,20 @@ import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 SWEEP = os.path.join(REPO, "TPU_BLOCK_SWEEP.jsonl")
+DEFAULT = (64, 512)  # bench.py's RESERVOIR_BENCH_BLOCK_R / kernel chunk
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 
-def pick_best(max_compile_s: float, since: str) -> "tuple[int, float] | None":
-    """(block_r, elem_per_sec) of the best sanely-compiling block, from the
-    LATEST record per block size stamped >= ``since`` (ISO timestamps
-    compare lexicographically); None without usable data."""
+def pick_best(
+    max_compile_s: float, since: str
+) -> "tuple[tuple[int, int], float] | None":
+    """((block_r, chunk_b), elem_per_sec) of the best sanely-compiling
+    variant, from the LATEST record per variant stamped >= ``since`` (ISO
+    timestamps compare lexicographically); None without usable data."""
     if not os.path.exists(SWEEP):
         return None
-    per_block: dict = {}
+    per_variant: dict = {}
     with open(SWEEP) as f:
         for line in f:
             try:
@@ -52,11 +58,16 @@ def pick_best(max_compile_s: float, since: str) -> "tuple[int, float] | None":
             res = rec.get("result")
             if not res or res.get("compile_plus_first_run_s", 1e9) > max_compile_s:
                 continue
-            per_block[res["block_r"]] = res["elem_per_sec"]
-    if not per_block:
+            # pre-r4 records carry no chunk_b: those measured the then-
+            # current FULL-WIDTH kernel (chunking landed in r4), so the
+            # faithful default is 0 — the since-gate normally excludes
+            # them anyway
+            variant = (res["block_r"], res.get("chunk_b", 0))
+            per_variant[variant] = res["elem_per_sec"]
+    if not per_variant:
         return None
-    best = max(per_block, key=per_block.get)  # ties: any
-    return best, per_block[best]
+    best = max(per_variant, key=per_variant.get)  # ties: any
+    return best, per_variant[best]
 
 
 def main() -> int:
@@ -75,26 +86,33 @@ def main() -> int:
             flush=True,
         )
         return 1
-    block, rate = best
-    if block == 64:
+    (block, chunk), rate = best
+    if (block, chunk) == DEFAULT:
         print(
-            f"block 64 is already the sweep winner ({rate:.3g} elem/s)",
+            f"default block {block} chunk {chunk} is already the sweep "
+            f"winner ({rate:.3g} elem/s)",
             flush=True,
         )
         return 0
     print(
-        f"sweep winner: block {block} ({rate:.3g} elem/s); re-capturing "
-        "headline",
+        f"sweep winner: block {block} chunk {chunk} ({rate:.3g} elem/s); "
+        "re-capturing headline",
         flush=True,
     )
     from tpu_watch import capture_bench
 
     status = capture_bench(
-        f"algl_block{block}",
+        f"algl_block{block}_chunk{chunk}",
         bench_config="algl",
-        extra_env={"RESERVOIR_BENCH_BLOCK_R": str(block)},
+        extra_env={
+            # the selftest child inherits both knobs, so the winner's
+            # headline row carries parity+KS proven at the exact kernel
+            # shape that produced the number
+            "RESERVOIR_BENCH_BLOCK_R": str(block),
+            "RESERVOIR_ALGL_CHUNK_B": str(chunk),
+        },
     )
-    print(f"re-capture at block {block}: {status}", flush=True)
+    print(f"re-capture at block {block} chunk {chunk}: {status}", flush=True)
     return 0 if status == "ok" else 1
 
 

@@ -1,14 +1,18 @@
-"""Algorithm-L Pallas block sweep on the live TPU (VERDICT r2 item 4).
+"""Algorithm-L Pallas block/chunk sweep on the live TPU (VERDICT r2 item 4).
 
 Round 2 found block_r > 64 blew up Mosaic compile (>6 min, killed); the
-kernel has since been restructured (chunked one-hot gathers).  This script
-measures, per block size, compile wall time and steady-state throughput —
+kernel has since been restructured (chunked one-hot gathers).  Round 4 adds
+the chunk-width axis: the captured headline at block 64 came in ~25% under
+r3's full-width-gather number, so each variant is a (block_r, chunk_b)
+pair — chunk 0 = full-width gathers, the pre-r4 shape.  This script
+measures, per variant, compile wall time and steady-state throughput —
 each in a THROWAWAY subprocess with a hard timeout, so a compile blowup
 costs its timeout and is recorded, never inherited.  Appends JSON lines to
 ``TPU_BLOCK_SWEEP.jsonl``.
 
 Usage (only sensible against a live TPU backend):
-    python tools/tpu_algl_block_sweep.py [--blocks 64,128,256] [--timeout 420]
+    python tools/tpu_algl_block_sweep.py [--variants 64:512,64:0,128:512]
+                                         [--timeout 420]
 """
 
 from __future__ import annotations
@@ -25,10 +29,12 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 OUT = os.path.join(REPO, "TPU_BLOCK_SWEEP.jsonl")
 
 _CHILD = r"""
-import json, sys, time
+import json, os, sys, time
+block_r = int(sys.argv[1])
+# must land in the env BEFORE the kernel module import reads it
+os.environ["RESERVOIR_ALGL_CHUNK_B"] = sys.argv[2]
 import jax, jax.numpy as jnp, jax.random as jr
 import functools
-block_r = int(sys.argv[1])
 R, k, B, steps = 65536, 128, 2048, 50
 from reservoir_tpu.ops import algorithm_l as al
 from reservoir_tpu.ops import algorithm_l_pallas as alp
@@ -58,6 +64,7 @@ for r in (1, 2):
     times.append(time.perf_counter() - t0)
 print(json.dumps({
     "block_r": block_r,
+    "chunk_b": int(sys.argv[2]),
     "compile_plus_first_run_s": round(compile_s, 2),
     "elem_per_sec": R * B * steps / min(times),
 }))
@@ -66,18 +73,25 @@ print(json.dumps({
 
 def main() -> int:
     ap = argparse.ArgumentParser()
-    ap.add_argument("--blocks", default="64,128,256")
+    ap.add_argument(
+        "--variants",
+        default="64:512,64:0,128:512,128:0",
+        help="comma-separated block_r:chunk_b pairs (chunk 0 = full-width)",
+    )
     ap.add_argument("--timeout", type=float, default=420.0)
     args = ap.parse_args()
-    for blk in args.blocks.split(","):
+    for variant in args.variants.split(","):
+        blk, _, chunk = variant.partition(":")
+        chunk = chunk or "512"
         t0 = time.time()
         rec = {
             "ts": datetime.datetime.now(datetime.timezone.utc).isoformat(),
             "block_r": int(blk),
+            "chunk_b": int(chunk),
         }
         try:
             proc = subprocess.run(
-                [sys.executable, "-c", _CHILD, blk],
+                [sys.executable, "-c", _CHILD, blk, chunk],
                 capture_output=True,
                 timeout=args.timeout,
                 text=True,
