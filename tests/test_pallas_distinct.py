@@ -5,7 +5,6 @@ comparison is on the full state pytree (values, hash planes, size, count),
 not just results.  Runs the Mosaic interpreter on the CPU test mesh.
 """
 
-import jax
 import jax.numpy as jnp
 import jax.random as jr
 import numpy as np

@@ -18,8 +18,6 @@ import json
 import os
 import sys
 
-import pytest
-
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 

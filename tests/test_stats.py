@@ -1,0 +1,51 @@
+"""The shared KS-gate helper (`reservoir_tpu/utils/stats.py`) and the
+selftest's statistical check built on it.
+
+The formula lives in ONE module precisely so the CI gate
+(`tests/test_ks_gate.py`) and the bench-embedded on-backend selftest
+enforce the same contract; these tests pin the helper itself against
+known distributions and the selftest check end-to-end on CPU.
+"""
+
+import numpy as np
+
+from reservoir_tpu.utils.stats import KS_GATE, ks_one_sample_uniform
+
+
+def test_gate_is_the_baseline_one_percent():
+    assert KS_GATE == 0.01
+
+
+def test_ks_zero_for_a_perfect_grid():
+    # values hitting every (i + 0.5)/m quantile of uniform{0..n-1}: the
+    # ECDF straddles the diagonal, KS = 1/(2m) exactly
+    n, m = 1 << 20, 1 << 10
+    values = (np.arange(m) + 0.5) * (n / m)
+    ks = ks_one_sample_uniform(values.astype(np.int64), n)
+    assert abs(ks - 1 / (2 * m)) < 1e-9
+
+
+def test_ks_catches_a_shifted_sample():
+    # all mass in the top half: KS -> 0.5
+    n = 1 << 16
+    rng = np.random.default_rng(3)
+    values = rng.integers(n // 2, n, size=4096)
+    assert ks_one_sample_uniform(values, n) > 0.45
+
+
+def test_ks_accepts_true_uniform_draws():
+    n = 1 << 16
+    rng = np.random.default_rng(4)
+    values = rng.integers(0, n, size=131_072)
+    # null 95th percentile ~ 1.36/sqrt(131072) ~ 0.0038 << the 1% gate
+    assert ks_one_sample_uniform(values, n) < KS_GATE
+
+
+def test_selftest_ks_check_passes_on_cpu():
+    # the end-to-end check the bench embeds on TPU, driven on CPU: same
+    # shapes, same gate (plain XLA — no interpreter shrink needed)
+    from reservoir_tpu.utils.selftest import _check_ks
+
+    ks, ok = _check_ks(True)
+    assert ok, f"selftest KS gate failed: {ks}"
+    assert ks < KS_GATE
