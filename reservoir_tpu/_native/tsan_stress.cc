@@ -206,7 +206,105 @@ static int run_zero_copy_phase() {
   return 0;
 }
 
+// Phase 3: the RANGE-PARALLEL demux (pool workers scattering disjoint
+// row ranges) under TSAN, plus its sequential-contract equivalence: the
+// consumed-prefix count and the per-row contents must match a serial
+// simulation exactly, while a consumer drains concurrently (SPSC).
+namespace {
+
+constexpr int32_t kParStreams = 64;
+constexpr int32_t kParWidth = 256;
+constexpr int64_t kParBatch = 1 << 15;  // >= the parallel threshold
+
+int run_parallel_demux_phase() {
+  void* sb = rsv_staging_create(kParStreams, kParWidth, sizeof(int32_t), 1);
+  if (!sb) return 1;
+
+  // deterministic stop-point + content equivalence vs a serial simulation
+  std::vector<int32_t> streams(kParBatch), elems(kParBatch);
+  unsigned state = 99u;
+  for (int64_t i = 0; i < kParBatch; ++i) {
+    state = state * 1664525u + 1013904223u;
+    streams[i] = static_cast<int32_t>(state % kParStreams);
+    elems[i] = static_cast<int32_t>(state >> 8);
+  }
+  std::vector<std::vector<int32_t>> expect(kParStreams);
+  int64_t stop = kParBatch;
+  for (int64_t i = 0; i < kParBatch; ++i) {
+    auto& row = expect[streams[i]];
+    if (static_cast<int32_t>(row.size()) >= kParWidth) {
+      stop = i;
+      break;
+    }
+    row.push_back(elems[i]);
+  }
+  int64_t took = rsv_staging_push_interleaved(sb, streams.data(),
+                                              elems.data(), nullptr,
+                                              kParBatch);
+  if (took != stop) {
+    std::fprintf(stderr, "parallel stop mismatch: got=%lld want=%lld\n",
+                 static_cast<long long>(took), static_cast<long long>(stop));
+    return 1;
+  }
+  std::vector<int32_t> tile(static_cast<size_t>(kParStreams) * kParWidth);
+  std::vector<int32_t> valid(kParStreams);
+  if (rsv_staging_drain(sb, tile.data(), nullptr, valid.data()) != took)
+    return 1;
+  for (int32_t s = 0; s < kParStreams; ++s) {
+    if (valid[s] != static_cast<int32_t>(expect[s].size()) ||
+        std::memcmp(tile.data() + static_cast<size_t>(s) * kParWidth,
+                    expect[s].data(), expect[s].size() * sizeof(int32_t))) {
+      std::fprintf(stderr, "parallel row %d mismatch\n", s);
+      return 1;
+    }
+  }
+
+  // SPSC stress at parallel batch sizes: pool workers + concurrent drain
+  std::atomic<int64_t> p_pushed{0}, p_drained{0};
+  std::atomic<bool> p_done{false};
+  std::thread cons([&] {
+    while (true) {
+      int64_t got = rsv_staging_drain(sb, tile.data(), nullptr, valid.data());
+      if (got < 0) std::abort();
+      p_drained.fetch_add(got);
+      if (p_done.load() && got == 0) break;
+      std::this_thread::yield();
+    }
+  });
+  int64_t remaining = 20 * kParBatch;
+  while (remaining > 0) {
+    int64_t off = 0;
+    while (off < kParBatch) {
+      int64_t t = rsv_staging_push_interleaved(
+          sb, streams.data() + off, elems.data() + off, nullptr,
+          kParBatch - off);
+      if (t < 0) std::abort();
+      p_pushed.fetch_add(t);
+      off += t;
+      if (off < kParBatch) std::this_thread::yield();
+    }
+    remaining -= kParBatch;
+  }
+  p_done.store(true);
+  cons.join();
+  rsv_staging_destroy(sb);
+  if (p_pushed.load() != 20 * kParBatch ||
+      p_drained.load() != p_pushed.load()) {
+    std::fprintf(stderr, "parallel conservation violated\n");
+    return 1;
+  }
+  std::printf("tsan_stress parallel demux OK: stop=%lld, %lld through pool\n",
+              static_cast<long long>(stop),
+              static_cast<long long>(p_pushed.load()));
+  return 0;
+}
+
+}  // namespace
+
 int main() {
+  // force the pool on before its lazy init (phases 1/2 stay below the
+  // parallel threshold, so the first big push in phase 3 constructs it)
+  setenv("RESERVOIR_STAGING_THREADS", "4", 1);
   void* sb = rsv_staging_create(kStreams, kWidth, sizeof(int32_t), 1);
   if (!sb) {
     std::fprintf(stderr, "create failed\n");
@@ -232,5 +330,7 @@ int main() {
   rsv_staging_destroy(sb);
   std::printf("tsan_stress OK: %lld elements through %d streams\n",
               static_cast<long long>(expect), kStreams);
-  return run_zero_copy_phase();
+  int rc = run_zero_copy_phase();
+  if (rc != 0) return rc;
+  return run_parallel_demux_phase();
 }

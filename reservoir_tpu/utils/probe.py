@@ -23,15 +23,24 @@ PROBE_SNIPPET = (
 )
 
 
-def probe_backend_proc(timeout_s: float):
+def probe_backend_proc(timeout_s: float, platform: "str | None" = None):
     """Probe the default backend in a throwaway subprocess.
 
     Returns the platform string (e.g. ``"tpu"``) on success, None on
-    failure or hang.
+    failure or hang.  ``platform``: pin the child to a jax_platforms
+    string (e.g. ``"cpu"``, ``"tpu,cpu"``) via the in-process config
+    update — the ONLY pin that works here (the axon sitecustomize
+    overrides the ``JAX_PLATFORMS`` env var).
     """
+    snippet = PROBE_SNIPPET
+    if platform is not None:
+        snippet = (
+            f"import jax; jax.config.update('jax_platforms', {platform!r}); "
+            + snippet
+        )
     try:
         proc = subprocess.run(
-            [sys.executable, "-c", PROBE_SNIPPET],
+            [sys.executable, "-c", snippet],
             capture_output=True,
             timeout=timeout_s,
             text=True,

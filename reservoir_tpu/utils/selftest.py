@@ -203,23 +203,93 @@ def _check_ks(interpret: bool):
     return ks, ks < KS_GATE
 
 
-def device_selftest() -> Dict[str, Any]:
+def _check_ks_distinct():
+    """On-backend twin of ``tests/test_ks_gate.py::
+    test_distinct_mode_ks_uniform_over_distinct_values`` (VERDICT r4 item
+    6): inclusion uniform over DISTINCT values of a 2x-repeated stream
+    (``Sampler.scala:394-408`` semantics), same pool (N = R*k = 65,536,
+    null 95th pct ~0.0053) and the same literal 1% gate."""
+    import jax
+    import jax.numpy as jnp
+    import jax.random as jr
+    import numpy as np
+
+    from ..ops import distinct as dd
+    from .stats import KS_GATE, ks_one_sample_uniform
+
+    R, k, n, B = 2048, 32, 2048, 256
+    state = dd.init(jr.key(2), R, k)
+    fn = jax.jit(dd.update, donate_argnums=0)
+    for _rep in range(2):  # every value appears twice
+        for start in range(0, n, B):
+            batch = start + jax.lax.broadcasted_iota(jnp.int32, (R, B), 1)
+            state = fn(state, batch)
+    samples, sizes = dd.result(state)
+    assert int(np.asarray(sizes).min()) == k
+    ks = ks_one_sample_uniform(np.asarray(samples).ravel(), n)
+    return ks, ks < KS_GATE
+
+
+def _check_ks_weighted():
+    """On-backend twin of ``tests/test_ks_gate.py::
+    test_weighted_mode_ks_uniform_when_weights_equal``: equal weights
+    degrade A-ExpJ to uniform sampling, gated at the same 1% bound
+    (N = R*k = 65,536)."""
+    import jax
+    import jax.numpy as jnp
+    import jax.random as jr
+    import numpy as np
+
+    from ..ops import weighted as ww
+    from .stats import KS_GATE, ks_one_sample_uniform
+
+    R, k, n, B = 2048, 32, 4096, 512
+    state = ww.init(jr.key(3), R, k)
+    fn = jax.jit(ww.update, donate_argnums=0)
+    for start in range(0, n, B):
+        batch = start + jax.lax.broadcasted_iota(jnp.int32, (R, B), 1)
+        state = fn(state, batch, jnp.ones((R, B), jnp.float32))
+    samples, sizes = ww.result(state)
+    assert int(np.asarray(sizes).min()) == k
+    ks = ks_one_sample_uniform(np.asarray(samples).ravel(), n)
+    return ks, ks < KS_GATE
+
+
+def device_selftest(emit_partial=None) -> Dict[str, Any]:
     """Run every parity check on the live backend.
 
     Returns ``{"platform": ..., "algl": bool, "algl_fill": bool,
     "distinct": bool, "weighted": bool, "pallas_parity": bool,
-    "ks_ok": bool, ["ks_uniform": float], ["<name>_error": str],
-    ["ks_error": str]}`` — never raises; a crash in any check is recorded
-    as failure with the message under its own ``*_error`` key
-    (``ks_uniform`` is absent when the KS check itself crashed).
-    ``pallas_parity`` is strictly the AND of the bit-equality checks; the
-    KS gate reports separately.
+    "ks_ok": bool, ["ks_uniform": float],
+    "ks_distinct_ok": bool, ["ks_distinct": float],
+    "ks_weighted_ok": bool, ["ks_weighted": float],
+    ["<name>_error": str], ["ks*_error": str]}`` — never raises; a crash
+    in any check is recorded as failure with the message under its own
+    ``*_error`` key (the ``ks*`` distance keys are absent when that KS
+    check itself crashed).  ``pallas_parity`` is strictly the AND of the
+    bit-equality checks; the three KS gates (algl uniform, distinct-mode
+    uniform-over-distinct, weighted equal-weight uniform — VERDICT r4
+    item 6) report separately, each at the literal 1% BASELINE bound.
+
+    ``emit_partial``: optional callable invoked with a COPY of the result
+    dict after each completed stage (parity block, then each KS gate).
+    A subprocess caller prints these as they land so a wall-clock cap
+    hit mid-KS salvages the parity evidence instead of erasing it (the
+    r4 failure mode: one timeout cost the round its parity bit).
     """
     import jax
 
     platform = jax.default_backend()
     interpret = platform == "cpu"  # Mosaic lowers on TPU only
     out: Dict[str, Any] = {"platform": platform}
+
+    def _stage_done():
+        if emit_partial is not None:
+            try:
+                emit_partial(dict(out))
+            except Exception:
+                pass  # progress reporting must never kill the checks
+
     ok = True
     for name, fn in (
         ("algl", _check_algl),
@@ -234,16 +304,30 @@ def device_selftest() -> Dict[str, Any]:
             out[f"{name}_error"] = f"{type(e).__name__}: {e}"[:500]
         ok = ok and out[name]
     out["pallas_parity"] = ok
+    _stage_done()
     try:
         out["ks_uniform"], out["ks_ok"] = _check_ks(interpret)
     except Exception as e:
         out["ks_ok"] = False
         out["ks_error"] = f"{type(e).__name__}: {e}"[:500]
+    _stage_done()
+    for name, fn in (
+        ("ks_distinct", _check_ks_distinct),
+        ("ks_weighted", _check_ks_weighted),
+    ):
+        try:
+            out[name], out[f"{name}_ok"] = fn()
+        except Exception as e:
+            out[f"{name}_ok"] = False
+            out[f"{name}_error"] = f"{type(e).__name__}: {e}"[:500]
+        _stage_done()
     return out
 
 
 def device_selftest_subprocess(
-    timeout_s: float = 900.0, skip_probe: bool = False
+    timeout_s: float = 900.0,
+    skip_probe: bool = False,
+    platform: "str | None" = None,
 ) -> Dict[str, Any]:
     """Run :func:`device_selftest` in a throwaway subprocess.
 
@@ -261,6 +345,12 @@ def device_selftest_subprocess(
     straight to work (bench.py runs the selftest in exactly that gap;
     r4: the post-run selftest always failed its probe because the bench
     parent still held the tunnel client even after ``clear_backends``).
+
+    ``platform``: pin the child (and its probe) to a jax_platforms
+    string so a pinned-platform caller gets evidence from the backend it
+    is actually measuring, not the process default (the axon
+    sitecustomize overrides ``JAX_PLATFORMS``, so the pin rides an
+    in-process config update in the child).
     """
     import json
     import os
@@ -274,15 +364,41 @@ def device_selftest_subprocess(
     # the full selftest timeout (backend init hangs inside jax.devices())
     from .probe import probe_backend_proc
 
-    if not skip_probe and probe_backend_proc(60.0) is None:
+    if not skip_probe and probe_backend_proc(60.0, platform) is None:
         return {
             "pallas_parity": False,
             "error": "backend unreachable (probe failed/hung)",
         }
-    code = (
-        "import json; from reservoir_tpu.utils.selftest import "
-        "device_selftest; print(json.dumps(device_selftest()))"
+    # The child prints a JSON line after EVERY completed stage (parity
+    # block, then each KS gate) and the parent keeps the last parseable
+    # one — so a timeout mid-KS salvages the parity evidence instead of
+    # erasing it (r4: one 900 s timeout cost the round its parity bit).
+    pin = (
+        f"import jax; jax.config.update('jax_platforms', {platform!r})\n"
+        if platform is not None
+        else ""
     )
+    code = (
+        pin
+        + "import json, sys\n"
+        "from reservoir_tpu.utils.selftest import device_selftest\n"
+        "def _p(d):\n"
+        "    sys.stdout.write(json.dumps(d) + '\\n'); sys.stdout.flush()\n"
+        "_p(device_selftest(emit_partial=_p))\n"
+    )
+
+    def _last_json(text_out):
+        if isinstance(text_out, bytes):
+            text_out = text_out.decode(errors="replace")
+        for line in reversed((text_out or "").splitlines()):
+            line = line.strip()
+            if line.startswith("{"):
+                try:
+                    return json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+        return None
+
     try:
         proc = subprocess.run(
             [sys.executable, "-c", code],
@@ -291,18 +407,29 @@ def device_selftest_subprocess(
             text=True,
             cwd=repo,
         )
-    except subprocess.TimeoutExpired:
+    except subprocess.TimeoutExpired as e:
+        salvaged = _last_json(e.stdout)
+        if salvaged is not None:
+            salvaged["partial"] = (
+                f"timed out after {timeout_s:.0f}s; last completed stage kept"
+            )
+            return salvaged
         return {
             "pallas_parity": False,
             "error": f"selftest subprocess timed out after {timeout_s:.0f}s",
         }
-    for line in reversed(proc.stdout.splitlines()):
-        line = line.strip()
-        if line.startswith("{"):
-            try:
-                return json.loads(line)
-            except json.JSONDecodeError:
-                break
+    parsed = _last_json(proc.stdout)
+    if parsed is not None:
+        if proc.returncode != 0:
+            # the child died AFTER emitting this stage (e.g. a Mosaic
+            # segfault mid-KS — the hazard the isolation exists for):
+            # keep the completed-stage evidence but never pass it off
+            # as a clean full run
+            parsed["partial"] = (
+                f"child crashed rc={proc.returncode} after last emitted "
+                "stage: " + proc.stderr[-300:]
+            )
+        return parsed
     return {
         "pallas_parity": False,
         "error": (

@@ -16,7 +16,7 @@ def test_gate_is_the_baseline_one_percent():
     assert KS_GATE == 0.01
 
 
-def test_ks_zero_for_a_perfect_grid():
+def test_ks_half_gridstep_for_a_perfect_grid():
     # values hitting every (i + 0.5)/m quantile of uniform{0..n-1}: the
     # ECDF straddles the diagonal, KS = 1/(2m) exactly
     n, m = 1 << 20, 1 << 10

@@ -28,10 +28,45 @@ import sys
 import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-CAPTURE = os.path.join(REPO, "TPU_CAPTURE_r04.jsonl")
+CAPTURE = os.path.join(REPO, "TPU_CAPTURE_r05.jsonl")
 PROBE_INTERVAL = 180.0
 PROBE_TIMEOUT = 90.0
 BENCH_TIMEOUT = 2400.0
+
+# Per-config window budgets (VERDICT r4 item 8): r4's transfer capture
+# burned 974 s of an 18-minute window on one wire-ceiling row.  Each
+# entry caps the bench child's wall time and pins the embedded selftest
+# knobs so a short window yields several rows instead of one or two.
+# Device-bound configs carry the pre-init parity selftest (capped);
+# host-path configs skip it — their evidence is the host-stage table,
+# and the parity bits ride the algl/distinct/weighted rows.
+CONFIG_BUDGETS: dict[str, tuple[float, dict[str, str]]] = {
+    "algl": (900.0, {"RESERVOIR_BENCH_SELFTEST_TIMEOUT": "300"}),
+    # the CHUNK_B=0 A/B (VERDICT r4 item 2): full-width gathers, the
+    # pre-r4 kernel shape, parity-pinned like the default
+    "algl_chunk0": (900.0, {"RESERVOIR_BENCH_SELFTEST_TIMEOUT": "300"}),
+    # bench defaults the selftest to the algl config only — the distinct/
+    # weighted captures must opt IN so their rows carry embedded parity +
+    # their own KS gates (VERDICT r4 items 3 and 6)
+    "distinct": (
+        700.0,
+        {
+            "RESERVOIR_BENCH_SELFTEST": "1",
+            "RESERVOIR_BENCH_SELFTEST_TIMEOUT": "300",
+        },
+    ),
+    "weighted": (
+        700.0,
+        {
+            "RESERVOIR_BENCH_SELFTEST": "1",
+            "RESERVOIR_BENCH_SELFTEST_TIMEOUT": "300",
+        },
+    ),
+    "stream": (420.0, {"RESERVOIR_BENCH_SELFTEST": "0"}),
+    "bridge": (420.0, {"RESERVOIR_BENCH_SELFTEST": "0"}),
+    "bridge_serial": (420.0, {"RESERVOIR_BENCH_SELFTEST": "0"}),
+    "transfer": (240.0, {"RESERVOIR_BENCH_SELFTEST": "0"}),
+}
 
 def _now() -> str:
     return datetime.datetime.now(datetime.timezone.utc).isoformat()
@@ -76,15 +111,25 @@ def capture_bench(
     next window), or ``"unreachable"`` (the tunnel dropped mid-window —
     the caller should stop burning this window on the remaining configs).
     """
-    # "bridge_serial" is a pseudo-config: the bridge bench with
+    # Pseudo-configs: "bridge_serial" is the bridge bench with
     # double-buffering off, so one window yields the pipelined-vs-serial
-    # delta (VERDICT r3 item 2b) without a second window.
-    extra_env = dict(extra_env or {})
+    # delta (VERDICT r3 item 2b) without a second window; "algl_chunk0"
+    # is the headline with full-width gathers (RESERVOIR_ALGL_CHUNK_B=0,
+    # the pre-r4 kernel shape) for the 25%-regression A/B (r4 item 2).
+    budget = CONFIG_BUDGETS.get(config)
+    if budget is not None:
+        timeout_s = min(timeout_s, budget[0])
+        extra_env = {**budget[1], **(extra_env or {})}
+    else:
+        extra_env = dict(extra_env or {})
     if bench_config is None:
         bench_config = config
         if config == "bridge_serial":
             bench_config = "bridge"
-            extra_env["RESERVOIR_BENCH_BRIDGE_PIPELINED"] = "0"
+            extra_env.setdefault("RESERVOIR_BENCH_BRIDGE_PIPELINED", "0")
+        elif config == "algl_chunk0":
+            bench_config = "algl"
+            extra_env.setdefault("RESERVOIR_ALGL_CHUNK_B", "0")
     env = dict(os.environ, RESERVOIR_BENCH_CONFIG=bench_config, **extra_env)
     t0 = time.time()
     try:
@@ -97,9 +142,11 @@ def capture_bench(
             cwd=REPO,
         )
     except subprocess.TimeoutExpired as e:
-        # salvage any JSON line already printed: the bench prints its
-        # number before/without the selftest completing in some paths — a
-        # hang later in the run must not erase a captured measurement
+        # salvage any JSON line already printed.  Since the selftest moved
+        # pre-init (r4 fix) no JSON exists until after both selftest and
+        # timed run, so salvage now only covers a hang AFTER the JSON line
+        # was printed (e.g. teardown against a dropped tunnel) — a hang
+        # there must not erase a captured measurement.
         salvaged = None
         out = e.stdout or b""
         if isinstance(out, bytes):
@@ -226,7 +273,10 @@ def main() -> int:
     ap.add_argument("--max-hours", type=float, default=12.0)
     ap.add_argument(
         "--configs",
-        default="algl,transfer,bridge,bridge_serial,distinct,weighted,stream",
+        # r5 priority order (VERDICT r4): parity-attached headline first,
+        # then the CHUNK_B A/B, then the never-captured configs.  transfer
+        # is omitted — its wire-ceiling row was captured in r4.
+        default="algl,algl_chunk0,distinct,weighted,stream,bridge,bridge_serial",
         help="comma-separated bench configs to capture when the window opens",
     )
     args = ap.parse_args()
