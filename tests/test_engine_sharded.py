@@ -138,6 +138,48 @@ def test_bridge_sharded_end_to_end():
         np.testing.assert_array_equal(a, b)
 
 
+def test_bridge_sharded_interleaved_demux():
+    """Config-5's literal feed shape over the mesh (VERDICT r4 item 7):
+    interleaved (stream, element) pairs through the staging demux and the
+    pipelined flush path into a ``mesh_axis`` engine — bit-identical to
+    the single-device bridge with the same key."""
+    rng = np.random.default_rng(3)
+    n = 5000
+    ids = rng.integers(0, R, n).astype(np.int32)
+    vals = rng.integers(0, 1 << 20, n).astype(np.int32)
+    results = []
+    for mesh_axis in (None, "res"):
+        bridge = DeviceStreamBridge(_cfg(mesh_axis=mesh_axis), key=29)
+        bridge.push_interleaved(ids, vals)
+        bridge.complete()
+        results.append(bridge.sample.result())
+    single, sharded = results
+    assert len(single) == len(sharded) == R
+    for a, b in zip(single, sharded):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_bridge_sharded_weighted_interleaved():
+    """The weighted bridge (parallel weight plane through the demux) over
+    the mesh: same bit-identity bar as the uniform path."""
+    rng = np.random.default_rng(4)
+    n = 3000
+    ids = rng.integers(0, R, n).astype(np.int32)
+    vals = rng.integers(0, 1 << 20, n).astype(np.int32)
+    w = (0.25 + rng.random(n)).astype(np.float32)
+    results = []
+    for mesh_axis in (None, "res"):
+        bridge = DeviceStreamBridge(
+            _cfg(mesh_axis=mesh_axis, weighted=True), key=31
+        )
+        bridge.push_interleaved(ids, vals, w)
+        bridge.complete()
+        results.append(bridge.sample.result())
+    single, sharded = results
+    for a, b in zip(single, sharded):
+        np.testing.assert_array_equal(a, b)
+
+
 def test_engine_sharded_pallas_bit_identical():
     # the M4 Pallas kernel under shard_map: each device runs the kernel on
     # its own reservoir row-blocks (collective-free grid); results must be

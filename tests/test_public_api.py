@@ -92,3 +92,33 @@ def test_backward_compat_checker_semantics():
     # whole module removed
     modless = {"m": base["m"], "gone": {}}
     assert any("module removed" in e for e in gen.check_backward_compat(modless, base))
+
+
+def test_backward_compat_vs_latest_released_baseline():
+    """The LIVE released-baseline gate (VERDICT r4 item 5): since v0.1.0
+    the repo carries each release's manifest under ``released/``; the
+    current surface must stay backward compatible with the newest one —
+    the same check CI runs against the GitHub-release artifact, enforced
+    here on every local suite run too."""
+    import glob
+    import re
+
+    def _version_key(path):
+        # numeric sort (CI's `sort -V` twin): lexicographic would pin
+        # v0.9.0 over v0.10.0 once a component reaches two digits
+        m = re.search(r"api_manifest_v([0-9][0-9.]*)\.json$", path)
+        return tuple(int(x) for x in m.group(1).rstrip(".").split("."))
+
+    baselines = sorted(
+        glob.glob(os.path.join(_REPO, "released", "api_manifest_v*.json")),
+        key=_version_key,
+    )
+    assert baselines, "released/ baseline missing — v0.1.0 shipped one"
+    gen = _load_generator()
+    with open(baselines[-1]) as f:
+        released = json.load(f)
+    errors = gen.check_backward_compat(released, gen.build_manifest())
+    assert not errors, (
+        f"backward-incompatible with {os.path.basename(baselines[-1])}:\n"
+        + "\n".join(errors)
+    )
