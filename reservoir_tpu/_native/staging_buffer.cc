@@ -476,6 +476,11 @@ int64_t rsv_staging_push_interleaved(void* handle, const int32_t* streams,
   return i;
 }
 
+// The demux worker count this process would use (env/core-count derived;
+// 1 = serial).  Telemetry for the bridge's stage table — a capture on a
+// multi-core host records how parallel its demux actually was.
+int32_t rsv_staging_threads() { return planned_workers(); }
+
 // Current fill of one row — O(1) flush-due check for single-stream pushes.
 int32_t rsv_staging_fill(void* handle, int32_t stream) {
   auto* sb = static_cast<StagingBuffer*>(handle);

@@ -130,6 +130,9 @@ def _finish_load(lib: ctypes.CDLL) -> ctypes.CDLL:
         ctypes.c_void_p,
         ctypes.c_void_p,
     ]
+    if hasattr(lib, "rsv_staging_threads"):  # absent in a stale pre-r5 .so
+        lib.rsv_staging_threads.restype = ctypes.c_int32
+        lib.rsv_staging_threads.argtypes = []
     if hasattr(lib, "rsv_staging_attach"):  # absent in a stale pre-r4 .so
         lib.rsv_staging_attach.restype = ctypes.c_int32
         lib.rsv_staging_attach.argtypes = [
@@ -236,6 +239,14 @@ class NativeStaging:
     def available(self) -> bool:
         """True when the C++ path is live (False: numpy fallback)."""
         return self._lib is not None
+
+    def threads(self) -> int:
+        """Demux worker count the native pool would use (1 = serial; the
+        numpy fallback is always 1).  Telemetry for the bridge stage
+        table — a multi-core capture records its own parallelism."""
+        if self._lib is not None and hasattr(self._lib, "rsv_staging_threads"):
+            return int(self._lib.rsv_staging_threads())
+        return 1
 
     # --------------------------------------------------------- zero-copy mode
 

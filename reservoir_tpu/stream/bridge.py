@@ -208,6 +208,7 @@ class DeviceStreamBridge:
         )
         self._future: Future = Future()
         self._metrics = BridgeMetrics()
+        self._metrics.demux_threads = self._staging.threads()
 
     # ------------------------------------------------------------ properties
 

@@ -34,6 +34,12 @@ class BridgeMetrics:
     demux_s: float = 0.0
     drain_s: float = 0.0
     dispatch_s: float = 0.0
+    # demux worker count (native staging pool; 1 = serial/fallback) — a
+    # capture's stage table states how parallel its scatter actually was.
+    # init=False keeps the v0.1.0 __init__ signature released-stable (the
+    # backward-compat gate is strict about signature strings); the owner
+    # sets it post-construction.
+    demux_threads: int = dataclasses.field(default=1, init=False)
     _t0: Optional[float] = None
 
     def start(self) -> None:
@@ -60,6 +66,7 @@ class BridgeMetrics:
                 "demux_s": self.demux_s,
                 "drain_s": self.drain_s,
                 "dispatch_s": self.dispatch_s,
+                "demux_threads": self.demux_threads,
                 "demux_elem_per_s": rate(self.demux_s, self.elements),
                 "drain_elem_per_s": rate(self.drain_s, self.flushed_elements),
                 "dispatch_elem_per_s": rate(

@@ -176,6 +176,7 @@ def test_bridge_sharded_weighted_interleaved():
         bridge.complete()
         results.append(bridge.sample.result())
     single, sharded = results
+    assert len(single) == len(sharded) == R
     for a, b in zip(single, sharded):
         np.testing.assert_array_equal(a, b)
 

@@ -2,6 +2,13 @@
 
 The join mutates process-global JAX state, so the positive case runs in a
 subprocess; the in-process test only exercises the no-op path.
+
+Evidence scope: the positive join runs with ``num_processes=1`` — the
+single-machine environment has no second host, so the DCN rendezvous is
+exercised only degenerately (coordinator bring-up, idempotence, global
+mesh span).  A true multi-process join (N>1 exchanging addresses over
+DCN) is deliberately NOT claimed by this suite; it needs real multi-host
+hardware.
 """
 
 from __future__ import annotations

@@ -152,6 +152,7 @@ def run(st, weighted):
 for weighted in (False, True):
     nat = NativeStaging(S, B, np.int32, weighted=weighted)
     assert nat.available(), "native path must be live in the child"
+    assert nat.threads() == 4, nat.threads()  # env pin visible in telemetry
     os.environ["RESERVOIR_TPU_NO_NATIVE"] = "1"
     ref = NativeStaging(S, B, np.int32, weighted=weighted)
     assert not ref.available()

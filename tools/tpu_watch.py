@@ -45,6 +45,10 @@ CONFIG_BUDGETS: dict[str, tuple[float, dict[str, str]]] = {
     # the CHUNK_B=0 A/B (VERDICT r4 item 2): full-width gathers, the
     # pre-r4 kernel shape, parity-pinned like the default
     "algl_chunk0": (900.0, {"RESERVOIR_BENCH_SELFTEST_TIMEOUT": "300"}),
+    # candidate headline raiser (r4 follow-up note): 2x batch width
+    # amortizes per-tile overheads; selftest off — parity for the kernel
+    # rides the algl row, this is a shape probe
+    "algl_B4096": (600.0, {"RESERVOIR_BENCH_SELFTEST": "0"}),
     # bench defaults the selftest to the algl config only — the distinct/
     # weighted captures must opt IN so their rows carry embedded parity +
     # their own KS gates (VERDICT r4 items 3 and 6)
@@ -130,6 +134,9 @@ def capture_bench(
         elif config == "algl_chunk0":
             bench_config = "algl"
             extra_env.setdefault("RESERVOIR_ALGL_CHUNK_B", "0")
+        elif config == "algl_B4096":
+            bench_config = "algl"
+            extra_env.setdefault("RESERVOIR_BENCH_B", "4096")
     env = dict(os.environ, RESERVOIR_BENCH_CONFIG=bench_config, **extra_env)
     t0 = time.time()
     try:
@@ -274,9 +281,11 @@ def main() -> int:
     ap.add_argument(
         "--configs",
         # r5 priority order (VERDICT r4): parity-attached headline first,
-        # then the CHUNK_B A/B, then the never-captured configs.  transfer
-        # is omitted — its wire-ceiling row was captured in r4.
-        default="algl,algl_chunk0,distinct,weighted,stream,bridge,bridge_serial",
+        # then the CHUNK_B A/B, then the never-captured configs, then the
+        # B=4096 headline-shape probe.  transfer is omitted — its
+        # wire-ceiling row was captured in r4.
+        default="algl,algl_chunk0,distinct,weighted,stream,bridge,"
+        "bridge_serial,algl_B4096",
         help="comma-separated bench configs to capture when the window opens",
     )
     args = ap.parse_args()
