@@ -1,0 +1,114 @@
+"""Watcher plumbing that must not fail during a real hardware window.
+
+The watcher itself needs live hardware; what IS testable is the pure
+plumbing a window exercises: pseudo-config env derivation, per-config
+budgets, and the evidence-durability commit (a window can land hours
+after the interactive session died — rows only survive if the watcher
+commits them itself).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(_REPO, "tools"))
+import tpu_watch  # noqa: E402
+
+sys.path.pop(0)
+
+
+def test_pseudo_configs_have_budgets():
+    # every config in the default queue must carry a wall budget — an
+    # unbudgeted config could burn a whole window (the r4 failure mode);
+    # reads the live DEFAULT_CONFIGS so a queue addition without a budget
+    # fails here
+    for c in tpu_watch.DEFAULT_CONFIGS.split(","):
+        assert c in tpu_watch.CONFIG_BUDGETS, f"{c} has no window budget"
+        timeout_s, env = tpu_watch.CONFIG_BUDGETS[c]
+        assert 0 < timeout_s <= 900
+
+
+def test_capture_commit_in_scratch_repo(tmp_path, monkeypatch):
+    # the durability commit: appended rows are committed; a second call
+    # with nothing new is a no-op; failures never raise
+    repo = tmp_path / "scratch"
+    repo.mkdir()
+    subprocess.run(["git", "init", "-q"], cwd=repo, check=True)
+    subprocess.run(["git", "config", "user.email", "t@t"], cwd=repo, check=True)
+    subprocess.run(["git", "config", "user.name", "t"], cwd=repo, check=True)
+    subprocess.run(
+        ["git", "commit", "--allow-empty", "-q", "-m", "root"],
+        cwd=repo,
+        check=True,
+    )
+    cap = repo / "TPU_CAPTURE_r99.jsonl"
+    monkeypatch.setattr(tpu_watch, "REPO", str(repo))
+    monkeypatch.setattr(tpu_watch, "CAPTURE", str(cap))
+
+    with open(cap, "a") as f:
+        f.write(json.dumps({"ts": "t0", "event": "tpu_up"}) + "\n")
+    tpu_watch._commit_capture("unit test")
+    log = subprocess.run(
+        ["git", "log", "--oneline"], cwd=repo, capture_output=True, text=True
+    ).stdout
+    assert "TPU capture window: unit test" in log
+
+    # idempotent when nothing new appended
+    tpu_watch._commit_capture("again")
+    log2 = subprocess.run(
+        ["git", "log", "--oneline"], cwd=repo, capture_output=True, text=True
+    ).stdout
+    assert log2.count("TPU capture window") == 1
+
+    # a second append commits again
+    with open(cap, "a") as f:
+        f.write(json.dumps({"ts": "t1", "config": "algl", "rc": 0}) + "\n")
+    tpu_watch._commit_capture("second window")
+    log3 = subprocess.run(
+        ["git", "log", "--oneline"], cwd=repo, capture_output=True, text=True
+    ).stdout
+    assert "second window" in log3
+
+
+def test_capture_commit_never_raises_without_git(tmp_path, monkeypatch):
+    # a broken git environment must cost a log line, not the watch loop
+    monkeypatch.setattr(tpu_watch, "REPO", str(tmp_path))  # not a repo
+    monkeypatch.setattr(
+        tpu_watch, "CAPTURE", str(tmp_path / "TPU_CAPTURE_r99.jsonl")
+    )
+    tpu_watch._commit_capture("no repo here")  # must not raise
+
+
+@pytest.mark.parametrize(
+    "config,expect_env",
+    [
+        ("bridge_serial", {"RESERVOIR_BENCH_BRIDGE_PIPELINED": "0"}),
+        ("algl_chunk0", {"RESERVOIR_ALGL_CHUNK_B": "0"}),
+        ("algl_B4096", {"RESERVOIR_BENCH_B": "4096"}),
+    ],
+)
+def test_pseudo_config_env_derivation(config, expect_env, monkeypatch):
+    # capture_bench must translate pseudo-configs into the right bench
+    # config + env; intercept subprocess.run to observe without running
+    seen = {}
+
+    class _Done(Exception):
+        pass
+
+    def fake_run(cmd, **kw):
+        seen["env"] = kw.get("env", {})
+        raise _Done
+
+    monkeypatch.setattr(tpu_watch.subprocess, "run", fake_run)
+    with pytest.raises(_Done):
+        tpu_watch.capture_bench(config)
+    env = seen["env"]
+    for k, v in expect_env.items():
+        assert env.get(k) == v, (k, env.get(k))
+    assert env.get("RESERVOIR_BENCH_CONFIG") in ("bridge", "algl")

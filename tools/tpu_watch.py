@@ -72,6 +72,16 @@ CONFIG_BUDGETS: dict[str, tuple[float, dict[str, str]]] = {
     "transfer": (240.0, {"RESERVOIR_BENCH_SELFTEST": "0"}),
 }
 
+# r5 priority order (VERDICT r4): parity-attached headline first, then
+# the CHUNK_B A/B, then the never-captured configs, then the B=4096
+# headline-shape probe.  transfer is omitted — its wire-ceiling row was
+# captured in r4.  Module-level so tests can assert every entry carries
+# a CONFIG_BUDGETS row (an unbudgeted config can burn a whole window).
+DEFAULT_CONFIGS = (
+    "algl,algl_chunk0,distinct,weighted,stream,bridge,"
+    "bridge_serial,algl_B4096"
+)
+
 def _now() -> str:
     return datetime.datetime.now(datetime.timezone.utc).isoformat()
 
@@ -206,6 +216,45 @@ def capture_bench(
     return "ok"
 
 
+def _commit_capture(context: str) -> None:
+    """Commit the capture file after a window (evidence durability: a
+    window can land hours after the interactive session died; committed
+    rows survive, uncommitted ones historically did not)."""
+    try:
+        subprocess.run(
+            ["git", "add", os.path.basename(CAPTURE)],
+            cwd=REPO,
+            check=True,
+            capture_output=True,
+            timeout=60,
+        )
+        staged = subprocess.run(
+            ["git", "diff", "--cached", "--quiet", "--",
+             os.path.basename(CAPTURE)],
+            cwd=REPO,
+            timeout=60,
+        )
+        if staged.returncode == 0:
+            return  # nothing new
+        subprocess.run(
+            [
+                "git",
+                "commit",
+                "-m",
+                f"TPU capture window: {context}",
+                "--only",
+                os.path.basename(CAPTURE),
+            ],
+            cwd=REPO,
+            check=True,
+            capture_output=True,
+            timeout=60,
+        )
+        print(f"[{_now()}] capture file committed ({context})", flush=True)
+    except (OSError, subprocess.SubprocessError) as e:
+        print(f"[{_now()}] capture commit failed: {e}", flush=True)
+
+
 def _run_post_step(name: str, cmd: list[str], timeout_s: float, env=None) -> bool:
     """Run one post-capture step (block sweep / device tests) in a child
     with a hard timeout, appending the outcome to the capture file."""
@@ -280,12 +329,7 @@ def main() -> int:
     ap.add_argument("--max-hours", type=float, default=12.0)
     ap.add_argument(
         "--configs",
-        # r5 priority order (VERDICT r4): parity-attached headline first,
-        # then the CHUNK_B A/B, then the never-captured configs, then the
-        # B=4096 headline-shape probe.  transfer is omitted — its
-        # wire-ceiling row was captured in r4.
-        default="algl,algl_chunk0,distinct,weighted,stream,bridge,"
-        "bridge_serial,algl_B4096",
+        default=DEFAULT_CONFIGS,
         help="comma-separated bench configs to capture when the window opens",
     )
     args = ap.parse_args()
@@ -323,6 +367,11 @@ def main() -> int:
                     dropped = True
                     break
             remaining = still
+            captured = [c for c in args.configs.split(",") if c and c not in still]
+            _commit_capture(
+                f"{len(captured)}/{len(args.configs.split(','))} configs "
+                f"captured ({','.join(captured) or 'none'})"
+            )
             if not dropped:
                 # SEQUENTIAL gating: a later step may depend on an earlier
                 # one's output (best-block reads the sweep's file), so the
@@ -333,6 +382,8 @@ def main() -> int:
                     if not _run_post_step(step[0], step[1], step[2], step[3]):
                         break
                     done_upto += 1
+                if done_upto:
+                    _commit_capture(f"{done_upto} post-step(s) recorded")
                 post_remaining = post_remaining[done_upto:]
             if not remaining and not post_remaining:
                 print(f"[{_now()}] capture complete", flush=True)
