@@ -67,8 +67,24 @@ def test_fuzz_distinct(R, k, B, steps):
 
 
 @pytest.mark.parametrize("R,k,B,steps", _CASES)
+def test_fuzz_algl_fill(R, k, B, steps):
+    # the fill-capable kernel (r4) from an EMPTY state: random (k, B)
+    # relations place the fill->steady boundary at tile starts, mid-tile,
+    # and across several tiles — the count-offset fill scatter
+    # (dest = count + lane) and the same-tile fill-then-accept handoff
+    # are exactly the cases the hand-picked suites can't enumerate
+    s_ref = s_pal = al.init(jr.key(R * 1000 + k + 3), R, k)
+    for step in range(steps + 1):  # +1: guarantee the boundary is crossed
+        key = jr.fold_in(jr.key(13), step)
+        b = jr.randint(key, (R, B), 0, 1 << 30, jnp.int32)
+        s_ref = al.update(s_ref, b)
+        s_pal = alp.update_pallas(s_pal, b, block_r=8, interpret=True)
+    _eq(s_ref, s_pal, ("samples", "count", "nxt", "log_w"))
+
+
+@pytest.mark.parametrize("R,k,B,steps", _CASES)
 def test_fuzz_algl_steady(R, k, B, steps):
-    # the Algorithm-L kernel is steady-only: fill first via the XLA path
+    # steady-state-only kernel entry: fill first via the XLA path
     s = al.init(jr.key(R * 1000 + k + 2), R, k)
     fill = jax.lax.broadcasted_iota(jnp.int32, (R, max(B, k)), 1)
     s = al.update(s, fill)

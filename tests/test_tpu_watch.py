@@ -18,6 +18,7 @@ import pytest
 
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, os.path.join(_REPO, "tools"))
+import tpu_capture_report  # noqa: E402
 import tpu_watch  # noqa: E402
 
 sys.path.pop(0)
@@ -83,6 +84,93 @@ def test_capture_commit_never_raises_without_git(tmp_path, monkeypatch):
         tpu_watch, "CAPTURE", str(tmp_path / "TPU_CAPTURE_r99.jsonl")
     )
     tpu_watch._commit_capture("no repo here")  # must not raise
+
+
+def test_capture_report_renders_ab_verdict(tmp_path):
+    # the round-end write-up path: rows (incl. embedded selftest flags)
+    # render into the table + per-config best + the chunk A/B verdict
+    cap = tmp_path / "TPU_CAPTURE_r98.jsonl"
+    rows = [
+        {"ts": "2026-07-31T00:00:00", "event": "tpu_up"},
+        {
+            "ts": "2026-07-31T00:01:00",
+            "config": "algl",
+            "rc": 0,
+            "wall_s": 100.0,
+            "result": {
+                "platform": "tpu",
+                "value": 2.0e10,
+                "vs_baseline": 20.0,
+                "pallas_parity": True,
+                "selftest": {
+                    "ks_ok": True,
+                    "ks_distinct_ok": True,
+                    "ks_weighted_ok": True,
+                },
+            },
+        },
+        {
+            "ts": "2026-07-31T00:10:00",
+            "config": "algl_chunk0",
+            "rc": 0,
+            "wall_s": 90.0,
+            "result": {
+                "platform": "tpu",
+                "value": 2.5e10,
+                "vs_baseline": 25.0,
+                "pallas_parity": True,
+                "selftest": {"ks_ok": True},
+            },
+        },
+    ]
+    with open(cap, "w") as f:
+        for r in rows:
+            f.write(json.dumps(r) + "\n")
+    text = tpu_capture_report.report(tpu_capture_report.load_rows([str(cap)]))
+    assert (
+        "| algl | tpu | 2.000e+10 | 20.00x | yes | yes | yes | yes | 0 |"
+        in text
+    )
+    assert "Best TPU row per config:" in text
+    # chunk0 wins here -> the verdict must prescribe the default flip
+    assert "winner: CHUNK_B=0" in text
+    assert "_GATHER_CHUNK_B" in text
+
+    # a timeout-salvaged duplicate with a higher value must NOT displace
+    # the clean row as best evidence (rc gate)
+    rows.append(
+        {
+            "ts": "2026-07-31T00:20:00",
+            "config": "algl",
+            "rc": "timeout",
+            "wall_s": 900.0,
+            "result": {
+                "platform": "tpu",
+                "value": 9.9e10,
+                "vs_baseline": 99.0,
+            },
+        }
+    )
+    with open(cap, "w") as f:
+        for r in rows:
+            f.write(json.dumps(r) + "\n")
+    text2 = tpu_capture_report.report(
+        tpu_capture_report.load_rows([str(cap)])
+    )
+    assert "- `algl`: 2.000e+10" in text2  # clean row still the best
+    assert "9.900e+10" in text2  # salvaged row visible in the table, with rc
+
+    # A/B rows from DIFFERENT files must not produce a prescription
+    cap2 = tmp_path / "TPU_CAPTURE_r99.jsonl"
+    with open(cap, "w") as f:
+        f.write(json.dumps(rows[1]) + "\n")  # algl only
+    with open(cap2, "w") as f:
+        f.write(json.dumps(rows[2]) + "\n")  # chunk0 only, other file
+    text3 = tpu_capture_report.report(
+        tpu_capture_report.load_rows([str(cap), str(cap2)])
+    )
+    assert "NOT a same-round comparison" in text3
+    assert "winner" not in text3
 
 
 @pytest.mark.parametrize(

@@ -1,0 +1,145 @@
+"""Render a TPU capture file into a BENCH.md-ready markdown table.
+
+The watcher (``tpu_watch.py``) appends timestamped JSON rows as windows
+open; at round end those rows must become the BENCH.md evidence table
+and the chunk-A/B verdict.  Windows can land minutes before a round
+closes — this renderer makes the write-up mechanical:
+
+    python tools/tpu_capture_report.py [TPU_CAPTURE_r05.jsonl ...]
+
+Prints one table row per successful bench capture (config, value,
+vs-baseline, parity + KS flags, wall time), a per-config best summary,
+and — when both ``algl`` and ``algl_chunk0`` rows exist — the A/B
+verdict the round owes (VERDICT r4 item 2).
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def load_rows(paths):
+    rows = []
+    for path in paths:
+        try:
+            with open(path) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        rows.append((os.path.basename(path), json.loads(line)))
+                    except json.JSONDecodeError:
+                        pass
+        except OSError:
+            pass
+    return rows
+
+
+def _flag(v):
+    return {True: "yes", False: "NO", None: "—"}.get(v, str(v))
+
+
+def report(rows) -> str:
+    out = []
+    captures = []
+    for src, rec in rows:
+        res = rec.get("result") or {}
+        if rec.get("config") and isinstance(res.get("value"), (int, float)):
+            captures.append((src, rec, res))
+
+    out.append(
+        "| config | platform | value (elem/s) | vs baseline | parity | "
+        "ks | ks_dist | ks_wtd | rc | wall s | ts |"
+    )
+    out.append("|---|---|---|---|---|---|---|---|---|---|---|")
+    for src, rec, res in captures:
+        st = res.get("selftest") or {}
+        out.append(
+            "| {config} | {platform} | {value:.3e} | {vs:.2f}x | {par} | "
+            "{ks} | {ksd} | {ksw} | {rc} | {wall} | {ts} |".format(
+                config=rec.get("config"),
+                platform=res.get("platform", "?"),
+                value=res["value"],
+                vs=res.get("vs_baseline") or 0.0,
+                par=_flag(res.get("pallas_parity", st.get("pallas_parity"))),
+                ks=_flag(st.get("ks_ok", res.get("ks_ok"))),
+                ksd=_flag(st.get("ks_distinct_ok")),
+                ksw=_flag(st.get("ks_weighted_ok")),
+                rc=rec.get("rc", "?"),
+                wall=rec.get("wall_s", "?"),
+                ts=(rec.get("ts") or "")[:19],
+            )
+        )
+
+    # per-config best: CLEAN (rc=0) TPU rows only — a timeout-salvaged or
+    # crashed-run row is context, never headline evidence
+    best = {}
+    for src, rec, res in captures:
+        if res.get("platform") != "tpu" or rec.get("rc") != 0:
+            continue
+        c = rec["config"]
+        if c not in best or res["value"] > best[c][2]["value"]:
+            best[c] = (src, rec, res)
+    if best:
+        out.append("")
+        out.append("Best TPU row per config:")
+        for c in sorted(best):
+            src, rec, res = best[c]
+            st = res.get("selftest") or {}
+            out.append(
+                f"- `{c}`: {res['value']:.3e} elem/s "
+                f"({(res.get('vs_baseline') or 0):.2f}x north star), "
+                f"parity={_flag(res.get('pallas_parity', st.get('pallas_parity')))}, "
+                f"ks={_flag(st.get('ks_ok', res.get('ks_ok')))} [{src}]"
+            )
+
+    # the chunk A/B verdict (VERDICT r4 item 2) — valid only when both
+    # rows come from the SAME capture file (same round / kernel state);
+    # cross-file comparisons are flagged, never prescribed
+    a = best.get("algl")
+    b = best.get("algl_chunk0")
+    if a and b:
+        va, vb = a[2]["value"], b[2]["value"]
+        winner = "CHUNK_B=512 (chunked, current default)" if va >= vb else (
+            "CHUNK_B=0 (full-width) — flip _GATHER_CHUNK_B default in "
+            "ops/algorithm_l_pallas.py"
+        )
+        out.append("")
+        if a[0] != b[0]:
+            out.append(
+                f"Chunk A/B: rows span different capture files "
+                f"([{a[0]}] vs [{b[0]}]) — NOT a same-round comparison; "
+                "re-capture both in one window before acting."
+            )
+        else:
+            out.append(
+                f"Chunk A/B [{a[0]}]: default {va:.3e} vs chunk0 {vb:.3e} "
+                f"({(max(va, vb) / max(min(va, vb), 1e-12) - 1) * 100:.1f}% "
+                f"gap) -> winner: {winner}"
+            )
+    return "\n".join(out)
+
+
+def main(argv) -> int:
+    paths = argv[1:] or sorted(
+        glob.glob(os.path.join(REPO, "TPU_CAPTURE_r*.jsonl"))
+    )
+    rows = load_rows(paths)
+    if not rows:
+        print("no capture rows found", file=sys.stderr)
+        return 1
+    try:
+        print(report(rows))
+    except BrokenPipeError:  # e.g. piped into head
+        return 0
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))

@@ -366,11 +366,16 @@ def main() -> int:
                     still.extend(remaining[i + 1 :])
                     dropped = True
                     break
+            # THIS window's captures (entry snapshot minus what's left):
+            # the commit message is the durable record of which window
+            # produced which rows
+            captured = [c for c in remaining if c not in still]
+            total = len([c for c in args.configs.split(",") if c])
             remaining = still
-            captured = [c for c in args.configs.split(",") if c and c not in still]
             _commit_capture(
-                f"{len(captured)}/{len(args.configs.split(','))} configs "
-                f"captured ({','.join(captured) or 'none'})"
+                f"{len(captured)} config(s) this window "
+                f"({','.join(captured) or 'none'}); {total - len(still)}/"
+                f"{total} cumulative"
             )
             if not dropped:
                 # SEQUENTIAL gating: a later step may depend on an earlier
