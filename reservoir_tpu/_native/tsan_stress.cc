@@ -76,6 +76,10 @@ void consumer(void* sb) {
   std::vector<int32_t> tile(static_cast<size_t>(kStreams) * kWidth);
   std::vector<int32_t> valid(kStreams);
   while (true) {
+    // snapshot before the drain (same exit race as the parallel-demux
+    // phase's consumer): a push landing between a zero drain and the flag
+    // read must not let the loop exit with elements staged
+    const bool done = producers_done.load();
     int64_t got = rsv_staging_drain(sb, tile.data(), nullptr, valid.data());
     if (got < 0) {
       std::fprintf(stderr, "drain failed\n");
@@ -83,7 +87,7 @@ void consumer(void* sb) {
     }
     drained.fetch_add(got);
     // exit only once producers are finished AND the buffer drained empty
-    if (producers_done.load() && got == 0) break;
+    if (done && got == 0) break;
     std::this_thread::yield();
   }
 }
@@ -264,10 +268,17 @@ int run_parallel_demux_phase() {
   std::atomic<bool> p_done{false};
   std::thread cons([&] {
     while (true) {
+      // snapshot the flag BEFORE draining: if the producer pushes its
+      // final batch and sets p_done between a zero-result drain and the
+      // flag check, breaking on the stale got==0 would strand elements
+      // and fail the conservation gate below.  done-before-drain means
+      // "done && got == 0" proves the buffer was empty after the last
+      // push.
+      const bool done = p_done.load();
       int64_t got = rsv_staging_drain(sb, tile.data(), nullptr, valid.data());
       if (got < 0) std::abort();
       p_drained.fetch_add(got);
-      if (p_done.load() && got == 0) break;
+      if (done && got == 0) break;
       std::this_thread::yield();
     }
   });

@@ -178,6 +178,9 @@ class ReservoirEngine:
         # fill vs steady dispatch with no device readback.
         self._min_count = 0
         self._jit_cache: dict = {}
+        # jit-cache key -> autotuned Geometry (or None = kernel defaults);
+        # observability for tests and the capture tooling
+        self._geometry_by_key: dict = {}
         # set by sample_stream around its per-tile loop after it validated
         # the whole weights array, so sample() skips the per-tile re-scan
         self._weights_prevalidated = False
@@ -308,17 +311,50 @@ class ReservoirEngine:
             return f"impl='auto' on backend {jax.default_backend()!r}"
         return None
 
-    def _base_update(self, steady: bool, use_pallas: bool):
+    def _algl_geometry(self, width: int, tile_dtype):
+        """Tuned ``(block_r, chunk_b, gather_chunk)`` for this tile shape
+        from the persistent autotune cache (:mod:`reservoir_tpu.ops.autotune`),
+        or None — the kernel then uses its hardcoded defaults, so untuned
+        devices (every CPU/interpret run) behave exactly as before."""
+        if self._ops is not _algl:
+            return None
+        from .ops import autotune
+
+        try:
+            device_kind = jax.devices()[0].device_kind
+        except Exception:  # backend init failure surfaces elsewhere
+            return None
+        return autotune.lookup(
+            device_kind,
+            self._config.num_reservoirs,
+            self._config.max_sample_size,
+            width,
+            tile_dtype,
+        )
+
+    def _base_update(self, steady: bool, use_pallas: bool, geometry=None):
         """The traceable per-tile update ``(state, tile[, weights][, valid])
         -> state`` for this mode — Pallas kernel (shard_map-wrapped on a
         mesh) or XLA path.  Shared by the per-tile jit cache and the fused
-        stream scan."""
+        stream scan.  ``geometry`` (algl only) is an autotuned
+        :class:`~reservoir_tpu.ops.autotune.Geometry` overriding the
+        kernel's block/chunk defaults."""
         if use_pallas:
             mod = self._pallas_module()
             if self._ops is _algl:
                 kernel = (
                     mod.update_steady_pallas if steady else mod.update_pallas
                 )
+                if geometry is not None:
+                    kernel = functools.partial(
+                        kernel,
+                        # 0 = "kernel default" for block (auto-size) and
+                        # chunk (whole tile); gather 0 is meaningful
+                        # (full-width) and passes through as-is
+                        block_r=geometry.block_r or None,
+                        chunk_b=geometry.chunk_b or None,
+                        gather_chunk=geometry.gather_chunk,
+                    )
             else:
                 kernel = mod.update_pallas
             base = functools.partial(
@@ -330,6 +366,8 @@ class ReservoirEngine:
                 # (the kernel is collective-free over the grid)
                 from jax.sharding import PartitionSpec as _P
 
+                from .parallel.sharded import shard_map as _shard_map
+
                 axis = self._config.mesh_axis
                 specs = jax.tree.map(
                     lambda x: _P(axis, *([None] * (x.ndim - 1))),
@@ -338,7 +376,7 @@ class ReservoirEngine:
                 tile_specs = (_P(axis, None),) * (
                     2 if self._config.weighted else 1
                 )
-                base = jax.shard_map(
+                base = _shard_map(
                     base,
                     mesh=self._mesh,
                     in_specs=(specs,) + tile_specs,
@@ -360,8 +398,15 @@ class ReservoirEngine:
         cache_key = (width, steady, ragged, use_pallas)
         fn = self._jit_cache.get(cache_key)
         if fn is None:
+            # autotuned geometry is resolved once per jit-cache entry (a
+            # stat + dict hit) — the compiled program then carries it
+            geometry = (
+                self._algl_geometry(width, tile_dtype) if use_pallas else None
+            )
+            self._geometry_by_key[cache_key] = geometry
             fn = jax.jit(
-                self._base_update(steady, use_pallas), donate_argnums=(0,)
+                self._base_update(steady, use_pallas, geometry),
+                donate_argnums=(0,),
             )
             self._jit_cache[cache_key] = fn
         return fn
@@ -646,7 +691,11 @@ class ReservoirEngine:
                      np.dtype(stream.dtype).str)
         fn = self._jit_cache.get(cache_key)
         if fn is None:
-            base = self._base_update(steady, use_pallas)
+            geometry = (
+                self._algl_geometry(B, stream.dtype) if use_pallas else None
+            )
+            self._geometry_by_key[cache_key] = geometry
+            base = self._base_update(steady, use_pallas, geometry)
             weighted = self._config.weighted
 
             def scan_fn(state, tiles, wtiles=None):

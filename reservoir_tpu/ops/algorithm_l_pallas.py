@@ -9,14 +9,30 @@ ref in place, so per-tile HBM traffic drops to exactly one read of the batch
 tile plus one read+write of the state block — the minimum the algorithm
 admits.
 
+Grid-pipelined batch streaming (the roofline restructure): the grid is 2-D,
+``(row-block, batch-chunk)``.  The ``[block_r, k]`` reservoir block and its
+scalar columns stay VMEM-resident across the whole batch axis (their block
+index ignores the chunk dimension, so Mosaic keeps one buffer and writes it
+back once per row-block), while the batch streams HBM→VMEM one
+``[block_r, chunk_b]`` chunk at a time.  Mosaic's grid pipeline
+double-buffers that input stream automatically: chunk ``j+1``'s DMA is in
+flight while chunk ``j``'s acceptance loop runs, so element reads approach
+wire rate instead of being serialized behind the ``while_loop``.  The
+per-shape geometry ``(block_r, chunk_b, gather_chunk)`` is tunable — see
+:mod:`.autotune` for the persistent cache the engine and bench consult.
+
 Bit-equivalence with the vmap path is by construction, not by luck: both
 paths run the *same* ``_advance_words`` trace (threefry counter draws keyed
-on the absolute accept index, :mod:`reservoir_tpu.ops.threefry`), so
+on the absolute accept index, :mod:`reservoir_tpu.ops.threefry`), and the
+acceptance indices are independent of the chunk decomposition (each lane's
+``nxt`` chain is consumed in order, chunk by chunk), so
 ``update_steady_pallas(state, tile) == update_steady(state, tile)`` holds
-exactly — pinned by ``tests/test_pallas_algl.py`` in interpret mode on CPU,
-and on hardware by the device-gated ``tests/test_pallas_device.py`` (skipped
-when no TPU backend is available; Mosaic's lowering of the log/exp chain in
-``_advance_words`` is only truly exercised there).
+exactly for every ``(block_r, chunk_b)`` geometry — pinned by
+``tests/test_pallas_algl.py`` in interpret mode on CPU (including chunk
+boundaries that split a lane's acceptance indices), and on hardware by the
+device-gated ``tests/test_pallas_device.py`` (skipped when no TPU backend
+is available; Mosaic's lowering of the log/exp chain in ``_advance_words``
+is only truly exercised there).
 
 Scope (``ReservoirEngine._update_fn`` dispatches here via :func:`supports`
 and falls back to the XLA path otherwise): steady state only
@@ -54,6 +70,12 @@ _DEFAULT_BLOCK_R = 64
 # shape) so a hardware window can A/B the chunking's runtime cost at the
 # proven block sizes — it exists for compile-time control, not speed.
 _GATHER_CHUNK_B = int(os.environ.get("RESERVOIR_ALGL_CHUNK_B", "512"))
+# batch-streaming chunk (the 2-D grid's inner axis): 0 = whole tile in one
+# grid cell (the pre-r6 shape, and the compile-proven default).  Nonzero
+# values stream the batch through VMEM chunk-by-chunk with Mosaic's
+# double-buffered grid pipeline; the sweep tool / autotune cache pick the
+# winner per device+shape.
+_STREAM_CHUNK_B = int(os.environ.get("RESERVOIR_ALGL_STREAM_CHUNK", "0"))
 
 
 def pick_block_r(num_reservoirs: int, k: int, tile_b: int) -> int:
@@ -88,41 +110,60 @@ def supports(
 
 def _kernel(samples_ref, count_ref, nxt_ref, logw_ref, key_ref, batch_ref,
             out_samples_ref, out_nxt_ref, out_logw_ref, *, k: int,
-            block_b: int, fill: bool):
-    """One grid cell = one ``[block_r]`` row-block of reservoirs × one tile.
+            chunk_b: int, gather_chunk: int, fill: bool):
+    """One grid cell = one ``[block_r]`` row-block × one ``[chunk_b]``
+    batch chunk.
+
+    The state blocks (``out_*``) are VMEM-resident across the whole chunk
+    axis — their index maps ignore the chunk dimension, so chunk ``j`` reads
+    the carry chunk ``j-1`` left behind and only the last chunk's result is
+    written back to HBM.  Chunk 0 seeds the carry from the inputs behind a
+    ``pl.when``.
 
     All per-reservoir scalars are ``[block_r, 1]`` columns (TPU wants >= 2-D);
     the acceptance loop is lockstep over the block's lanes with masked
-    updates — a lane whose chain is done rides along untouched, the exact
-    semantics of the vmapped ``while_loop`` it replaces.
+    updates — a lane whose chain is done (or whose next acceptance lies in a
+    later chunk) rides along untouched, the exact semantics of the vmapped
+    ``while_loop`` it replaces.  Because every lane consumes its ``nxt``
+    chain in order and each chunk only admits acceptances with
+    ``nxt <= count + (j+1)·chunk_b``, the draw sequence per lane is
+    identical to the single-chunk kernel — chunking cannot move an
+    acceptance index.
 
     ``fill=True`` additionally runs the fill-phase scatter (element with
     absolute index ``idx <= k`` goes to slot ``idx - 1``, arrival order —
     ``Sampler.scala:253-255``) as a k-step in-VMEM one-hot loop, the
-    weighted kernel's pattern (:mod:`.weighted_pallas`); steady tiles skip
-    it behind a ``pl.when`` so the hot path pays one compare.
+    weighted kernel's pattern (:mod:`.weighted_pallas`); chunks past the
+    fill prefix (and steady tiles) skip it behind a ``pl.when`` so the hot
+    path pays one compare.
     """
     count = count_ref[:, :]            # [r, 1] int32 (pre-tile count)
-    end = count + jnp.int32(block_b)
+    j = pl.program_id(1)
+    base = j * jnp.int32(chunk_b)      # this chunk's offset in the tile
+    end = count + base + jnp.int32(chunk_b)
     k1 = key_ref[:, 0:1]
     k2 = key_ref[:, 1:2]
     block_r = count.shape[0]
 
-    chunk_b = min(block_b, _GATHER_CHUNK_B) if _GATHER_CHUNK_B > 0 else block_b
-    if block_b % chunk_b != 0:  # odd widths: one full-width gather
-        chunk_b = block_b
-    n_chunks = block_b // chunk_b
-    lane_c = jax.lax.broadcasted_iota(jnp.int32, (block_r, chunk_b), 1)
+    g = min(chunk_b, gather_chunk) if gather_chunk > 0 else chunk_b
+    if chunk_b % g != 0:  # odd widths: one full-width gather
+        g = chunk_b
+    n_g = chunk_b // g
+    lane_c = jax.lax.broadcasted_iota(jnp.int32, (block_r, g), 1)
     lane_k = jax.lax.broadcasted_iota(jnp.int32, (block_r, k), 1)
 
-    # out refs start as copies of the inputs; acceptances mutate in place.
-    out_samples_ref[:, :] = samples_ref[:, :]
+    # chunk 0 seeds the VMEM-resident carry; later chunks mutate in place.
+    @pl.when(j == 0)
+    def _seed_carry():
+        out_samples_ref[:, :] = samples_ref[:, :]
+        out_nxt_ref[:, :] = nxt_ref[:, :]
+        out_logw_ref[:, :] = logw_ref[:, :]
 
     if fill:
-        lane_b = jax.lax.broadcasted_iota(jnp.int32, (block_r, block_b), 1)
-        # element at local lane j has absolute index count + j + 1; those
-        # with index <= k take slot count + j, in arrival order
-        dest = count + lane_b                     # [r, B]
+        lane_b = jax.lax.broadcasted_iota(jnp.int32, (block_r, chunk_b), 1)
+        # element at local lane j has absolute index count + base + j + 1;
+        # those with index <= k take slot count + base + j, in arrival order
+        dest = count + base + lane_b              # [r, chunk]
         dest = jnp.where(dest < k, dest, k)       # k -> dropped
         elem_bits_all = jax.lax.bitcast_convert_type(
             batch_ref[:, :], jnp.int32
@@ -144,7 +185,7 @@ def _kernel(samples_ref, count_ref, nxt_ref, logw_ref, key_ref, batch_ref,
             )
             return 0
 
-        @pl.when(jnp.any(count < k))
+        @pl.when(jnp.any(count + base < k))
         def _run_fill():
             jax.lax.fori_loop(0, k, fill_slot, 0)
 
@@ -155,20 +196,20 @@ def _kernel(samples_ref, count_ref, nxt_ref, logw_ref, key_ref, batch_ref,
     def body(carry):
         nxt, log_w = carry
         active = nxt <= end                       # [r, 1]
-        pos = nxt - count - 1                     # [r, 1] in [0, B) when active
+        pos = nxt - count - 1 - base              # [r, 1] in [0, chunk) active
         # gather batch[r, pos_r] as a one-hot masked reduction (no per-row
         # dynamic gather on the VPU), CHUNKED over the batch axis so each
-        # select+reduce touches a fixed [r, chunk_b] window — constant vreg
+        # select+reduce touches a fixed [r, g] window — constant vreg
         # footprint per instruction regardless of B (Mosaic compile-time
         # control, see _GATHER_CHUNK_B).
         # The sum is over integer bit patterns: exactly one lane across all
         # chunks is selected and the rest contribute literal zero, so the
         # total is exact for every dtype — including the float32 -0.0 sign
         # bit, which a float sum would drop (-0.0 + 0.0 == +0.0 in IEEE).
-        def gather_chunk(c, acc):
-            off = c * chunk_b
+        def gather_window(c, acc):
+            off = c * g
             bits = jax.lax.bitcast_convert_type(
-                batch_ref[:, pl.dslice(off, chunk_b)], jnp.int32
+                batch_ref[:, pl.dslice(off, g)], jnp.int32
             )
             onehot = lane_c == (pos - off)
             return acc + jnp.sum(
@@ -177,8 +218,8 @@ def _kernel(samples_ref, count_ref, nxt_ref, logw_ref, key_ref, batch_ref,
 
         elem_bits = jax.lax.fori_loop(
             0,
-            n_chunks,
-            gather_chunk,
+            n_g,
+            gather_window,
             jnp.zeros((block_r, 1), jnp.int32),
             unroll=False,
         )
@@ -193,7 +234,9 @@ def _kernel(samples_ref, count_ref, nxt_ref, logw_ref, key_ref, batch_ref,
             jnp.where(active, log_w_n, log_w),
         )
 
-    nxt, log_w = jax.lax.while_loop(cond, body, (nxt_ref[:, :], logw_ref[:, :]))
+    nxt, log_w = jax.lax.while_loop(
+        cond, body, (out_nxt_ref[:, :], out_logw_ref[:, :])
+    )
     out_nxt_ref[:, :] = nxt
     out_logw_ref[:, :] = log_w
 
@@ -203,6 +246,8 @@ def update_pallas(
     batch: jax.Array,
     *,
     block_r: "int | None" = None,
+    chunk_b: "int | None" = None,
+    gather_chunk: "int | None" = None,
     interpret: bool = False,
 ) -> ReservoirState:
     """FILL-CAPABLE tile update, bit-identical to
@@ -210,10 +255,12 @@ def update_pallas(
     whole stream life cycle, so ``impl="pallas"`` no longer falls back to
     XLA for fill/partially-filled tiles (VERDICT r3 item 7).  The fill
     scatter costs a k-step in-VMEM loop only while some reservoir in a
-    row-block is below k; steady blocks skip it behind one compare.
+    row-block is below k; steady blocks (and batch chunks past the fill
+    prefix) skip it behind one compare.
     """
     return _update_pallas(
-        state, batch, block_r=block_r, interpret=interpret, fill=True
+        state, batch, block_r=block_r, chunk_b=chunk_b,
+        gather_chunk=gather_chunk, interpret=interpret, fill=True,
     )
 
 
@@ -222,6 +269,8 @@ def update_steady_pallas(
     batch: jax.Array,
     *,
     block_r: "int | None" = None,
+    chunk_b: "int | None" = None,
+    gather_chunk: "int | None" = None,
     interpret: bool = False,
 ) -> ReservoirState:
     """Steady-state tile update, bit-identical to
@@ -229,13 +278,24 @@ def update_steady_pallas(
 
     ``batch`` is ``[R, B]``; reservoir r consumes its full row.  Requires
     :func:`supports`; ``interpret=True`` runs the Mosaic interpreter (CPU
-    equivalence tests).  ``block_r=None`` auto-sizes the row-block
-    (VMEM-aware, :func:`pick_block_r`); any R is accepted — a partial last
-    row-block is padded with inert lanes (``nxt`` pinned past the tile end,
-    so their acceptance loop never iterates) and sliced off.
+    equivalence tests).  Geometry knobs (see :mod:`.autotune` for the
+    persistent per-device cache):
+
+    - ``block_r``: reservoir rows per grid cell (``None`` = VMEM-aware
+      auto-size, :func:`pick_block_r`); any R is accepted — a partial last
+      row-block is padded with inert lanes (``nxt`` pinned past the tile
+      end, so their acceptance loop never iterates) and sliced off.
+    - ``chunk_b``: batch-streaming chunk — the tile's batch axis is split
+      into ``B // chunk_b`` grid cells whose HBM→VMEM loads Mosaic
+      double-buffers against the previous chunk's acceptance loop.
+      ``None``/0 (or a non-divisor of B) = whole tile in one cell.
+    - ``gather_chunk``: lanes per one-hot select+reduce inside the
+      acceptance loop (compile-time control; 0 = full width, ``None`` =
+      the ``RESERVOIR_ALGL_CHUNK_B`` env default).
     """
     return _update_pallas(
-        state, batch, block_r=block_r, interpret=interpret, fill=False
+        state, batch, block_r=block_r, chunk_b=chunk_b,
+        gather_chunk=gather_chunk, interpret=interpret, fill=False,
     )
 
 
@@ -244,6 +304,8 @@ def _update_pallas(
     batch: jax.Array,
     *,
     block_r: "int | None",
+    chunk_b: "int | None",
+    gather_chunk: "int | None",
     interpret: bool,
     fill: bool,
 ) -> ReservoirState:
@@ -261,6 +323,12 @@ def _update_pallas(
         )
     if block_r is None:
         block_r = pick_block_r(R, k, B)
+    if gather_chunk is None:
+        gather_chunk = _GATHER_CHUNK_B
+    if chunk_b is None:
+        chunk_b = _STREAM_CHUNK_B
+    if chunk_b <= 0 or chunk_b > B or B % chunk_b != 0:
+        chunk_b = B  # whole tile in one grid cell (the compile-proven shape)
     R_orig = R
     if R % block_r != 0:
         from .blocking import shrink_block_to
@@ -285,21 +353,32 @@ def _update_pallas(
     kd1, kd2 = key_words(state.key)               # [R] uint32 each
     key_data = jnp.stack([kd1, kd2], axis=1)      # [R, 2]
 
-    col = lambda i: (i, 0)  # noqa: E731 — row-block i, full second axis
+    # state blocks: row-block i, chunk-invariant (VMEM-resident across j)
+    col = lambda i, j: (i, 0)  # noqa: E731
     col_spec = lambda w: pl.BlockSpec(  # noqa: E731
         (block_r, w), col, memory_space=pltpu.VMEM
     )
 
     out_samples, out_nxt, out_logw = pl.pallas_call(
-        functools.partial(_kernel, k=k, block_b=B, fill=fill),
-        grid=(R // block_r,),
+        functools.partial(
+            _kernel, k=k, chunk_b=chunk_b, gather_chunk=gather_chunk,
+            fill=fill,
+        ),
+        grid=(R // block_r, B // chunk_b),
         in_specs=[
             col_spec(k),
             col_spec(1),
             col_spec(1),
             col_spec(1),
             col_spec(2),
-            col_spec(B),
+            # the streamed input: chunk j of row-block i — the only block
+            # whose index varies along the inner grid axis, so Mosaic's
+            # pipeline double-buffers exactly this HBM->VMEM stream
+            pl.BlockSpec(
+                (block_r, chunk_b),
+                lambda i, j: (i, j),
+                memory_space=pltpu.VMEM,
+            ),
         ],
         out_specs=(col_spec(k), col_spec(1), col_spec(1)),
         out_shape=(

@@ -1,0 +1,187 @@
+"""Persistent block-geometry autotuner for the Algorithm-L Pallas kernel.
+
+The kernel's throughput is set by three shape knobs — ``block_r``
+(reservoir rows per grid cell), ``chunk_b`` (batch-streaming chunk of the
+2-D grid pipeline) and ``gather_chunk`` (lanes per one-hot select+reduce) —
+whose winners are device- and shape-specific and can only be measured on
+live hardware.  Before this module, ``tools/tpu_algl_block_sweep.py``
+measured them into an append-only log nowhere the engine could see; now the
+sweep (and ``tools/tpu_algl_best_block.py``) record winners into a small
+JSON cache keyed by ``(device_kind, R, k, B, dtype)``, and
+``ReservoirEngine._update_fn`` / ``bench.py`` consult it at jit-cache time.
+
+Absent a cache entry (every CPU test run, any untuned device/shape) the
+lookup returns ``None`` and callers keep the hardcoded defaults, so
+interpret-mode behavior is byte-identical with or without the file.  The
+cache is *advisory geometry only* — every geometry is bit-identical by
+construction (see :mod:`.algorithm_l_pallas`), so a stale entry can cost
+speed, never correctness.
+
+File location: ``$RESERVOIR_ALGL_AUTOTUNE_CACHE`` if set, else
+``TPU_ALGL_AUTOTUNE.json`` at the repo root (committed with the sweep
+evidence so tuned geometry survives across sessions).  Writes are atomic
+(tmp + rename) and loads are mtime-memoized, so the per-jit lookup cost is
+a stat.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+from typing import NamedTuple, Optional
+
+import numpy as np
+
+__all__ = [
+    "Geometry",
+    "cache_path",
+    "make_key",
+    "load",
+    "lookup",
+    "record",
+    "record_if_better",
+]
+
+_REPO = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+_DEFAULT_CACHE = os.path.join(_REPO, "TPU_ALGL_AUTOTUNE.json")
+
+# (path, mtime) -> parsed dict; loads are hot (one per engine jit-cache
+# miss), files are tiny and almost never change mid-process
+_LOAD_MEMO: dict = {}
+
+
+class Geometry(NamedTuple):
+    """One tuned kernel geometry.
+
+    ``block_r``: rows per grid cell (0 = kernel auto-size).
+    ``chunk_b``: batch-streaming chunk (0 = whole tile, no 2-D grid).
+    ``gather_chunk``: one-hot gather window (0 = full width).
+    """
+
+    block_r: int
+    chunk_b: int
+    gather_chunk: int
+
+
+def cache_path() -> str:
+    return os.environ.get("RESERVOIR_ALGL_AUTOTUNE_CACHE", _DEFAULT_CACHE)
+
+
+def make_key(device_kind: str, R: int, k: int, B: int, dtype) -> str:
+    """Stable cache key: the geometry winner depends on all five."""
+    return f"{device_kind}|R={R}|k={k}|B={B}|{np.dtype(dtype).name}"
+
+
+def load(path: "str | None" = None) -> dict:
+    """The parsed cache file ({} when absent or unparseable — a corrupt
+    cache must degrade to defaults, never break sampling)."""
+    path = path or cache_path()
+    try:
+        mtime = os.stat(path).st_mtime_ns
+    except OSError:
+        return {}
+    memo = _LOAD_MEMO.get(path)
+    if memo is not None and memo[0] == mtime:
+        return memo[1]
+    try:
+        with open(path) as f:
+            data = json.load(f)
+        if not isinstance(data, dict):
+            data = {}
+    except (OSError, json.JSONDecodeError):
+        data = {}
+    _LOAD_MEMO[path] = (mtime, data)
+    return data
+
+
+def lookup(
+    device_kind: str,
+    R: int,
+    k: int,
+    B: int,
+    dtype,
+    path: "str | None" = None,
+) -> Optional[Geometry]:
+    """The tuned geometry for this device+shape, or None (use defaults)."""
+    entry = load(path).get(make_key(device_kind, R, k, B, dtype))
+    if not isinstance(entry, dict):
+        return None
+    try:
+        return Geometry(
+            block_r=int(entry["block_r"]),
+            chunk_b=int(entry.get("chunk_b", 0)),
+            gather_chunk=int(entry.get("gather_chunk", 0)),
+        )
+    except (KeyError, TypeError, ValueError):
+        return None
+
+
+def record(
+    device_kind: str,
+    R: int,
+    k: int,
+    B: int,
+    dtype,
+    geometry: Geometry,
+    elem_per_sec: "float | None" = None,
+    source: "str | None" = None,
+    path: "str | None" = None,
+) -> None:
+    """Write one geometry entry (atomic tmp+rename; merges with the
+    existing file).  ``elem_per_sec``/``source`` ride along as provenance —
+    :func:`record_if_better` uses the rate to keep only winners."""
+    path = path or cache_path()
+    data = dict(load(path))
+    entry = {
+        "block_r": int(geometry.block_r),
+        "chunk_b": int(geometry.chunk_b),
+        "gather_chunk": int(geometry.gather_chunk),
+    }
+    if elem_per_sec is not None:
+        entry["elem_per_sec"] = float(elem_per_sec)
+    if source is not None:
+        entry["source"] = source
+    data[make_key(device_kind, R, k, B, dtype)] = entry
+    d = os.path.dirname(os.path.abspath(path)) or "."
+    fd, tmp = tempfile.mkstemp(prefix=".autotune.", dir=d)
+    try:
+        with os.fdopen(fd, "w") as f:
+            json.dump(data, f, indent=1, sort_keys=True)
+            f.write("\n")
+        os.replace(tmp, path)
+    except OSError:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    _LOAD_MEMO.pop(path, None)
+
+
+def record_if_better(
+    device_kind: str,
+    R: int,
+    k: int,
+    B: int,
+    dtype,
+    geometry: Geometry,
+    elem_per_sec: float,
+    source: "str | None" = None,
+    path: "str | None" = None,
+) -> bool:
+    """Record only if no entry exists or this rate beats the stored one
+    (sweep callers: every variant reports through here, winners stick).
+    Returns whether the entry was written."""
+    entry = load(path).get(make_key(device_kind, R, k, B, dtype))
+    if isinstance(entry, dict):
+        prev = entry.get("elem_per_sec")
+        if isinstance(prev, (int, float)) and prev >= elem_per_sec:
+            return False
+    record(
+        device_kind, R, k, B, dtype, geometry,
+        elem_per_sec=elem_per_sec, source=source, path=path,
+    )
+    return True

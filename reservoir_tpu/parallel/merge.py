@@ -25,6 +25,7 @@ import jax
 import jax.random as jr
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from .sharded import shard_map as _shard_map
 from ..ops import algorithm_l as _algl
 from ..ops import distinct as _distinct
 from ..ops import weighted as _weighted
@@ -74,7 +75,7 @@ def uniform_stream_merger(mesh: Mesh, axis: str = "stream"):
         return items[0]
 
     return jax.jit(
-        jax.shard_map(
+        _shard_map(
             local,
             mesh=mesh,
             in_specs=(P(axis), P(axis), P()),
@@ -106,7 +107,7 @@ def _summary_merger(mesh: Mesh, axis: str, pairwise, n_leaves: int):
         return items[0]
 
     return jax.jit(
-        jax.shard_map(
+        _shard_map(
             local,
             mesh=mesh,
             in_specs=tuple(P(axis) for _ in range(n_leaves)),

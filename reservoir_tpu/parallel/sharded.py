@@ -40,11 +40,33 @@ from ..ops import algorithm_l as _algl
 __all__ = [
     "make_mesh",
     "reservoir_sharding",
+    "shard_map",
     "state_shardings",
     "shard_state",
     "sharded_update",
     "sharded_result",
 ]
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma=None):
+    """``jax.shard_map`` across the jax versions this repo meets.
+
+    Newer jax exposes it as ``jax.shard_map`` (with ``check_vma``); 0.4.x
+    only has ``jax.experimental.shard_map.shard_map`` (same semantics, the
+    flag is spelled ``check_rep``).  One compat seam so the engine's
+    Pallas-under-mesh path and the stream-axis mergers don't each carry
+    version probes."""
+    if hasattr(jax, "shard_map"):
+        kwargs = {} if check_vma is None else {"check_vma": check_vma}
+        return jax.shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kwargs
+        )
+    from jax.experimental.shard_map import shard_map as _sm
+
+    kwargs = {} if check_vma is None else {"check_rep": check_vma}
+    return _sm(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kwargs
+    )
 
 
 def make_mesh(

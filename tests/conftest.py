@@ -22,3 +22,10 @@ if os.environ.get("RESERVOIR_TPU_TEST_PLATFORM", "cpu8") == "cpu8":
     jax.config.update("jax_platforms", "cpu")
 else:  # pragma: no cover - hardware run
     import jax  # noqa: F401
+
+# ops.threefry pins bit-parity against jax.random's PARTITIONABLE counter
+# layout (the default on newer jax; see the module docstring).  On jax
+# versions where the flag still defaults off, flip it so the parity tests
+# compare against the layout the framework implements — the framework's own
+# draws (raw key words through ops.threefry) are flag-independent.
+jax.config.update("jax_threefry_partitionable", True)

@@ -1,0 +1,184 @@
+"""Persistent block-geometry autotuner (ops.autotune) + engine consumption.
+
+The acceptance contract: a planted cache entry changes the geometry the
+engine compiles the Algorithm-L Pallas kernel with; an absent (or corrupt)
+cache falls back to the kernel's hardcoded defaults, so CPU/interpret
+behavior is byte-identical with or without the file — and every geometry
+is bit-identical to the XLA path anyway, so a stale entry can cost speed,
+never correctness.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from reservoir_tpu import ReservoirEngine, SamplerConfig
+from reservoir_tpu.ops import autotune
+
+
+@pytest.fixture
+def cache(tmp_path, monkeypatch):
+    path = str(tmp_path / "autotune.json")
+    monkeypatch.setenv("RESERVOIR_ALGL_AUTOTUNE_CACHE", path)
+    return path
+
+
+class TestCacheFile:
+    def test_lookup_missing_file_is_none(self, cache):
+        assert autotune.lookup("tpu v5e", 64, 8, 128, "int32") is None
+
+    def test_record_lookup_roundtrip(self, cache):
+        geom = autotune.Geometry(64, 1024, 512)
+        autotune.record(
+            "tpu v5e", 65536, 128, 2048, "int32", geom,
+            elem_per_sec=1.5e10, source="unit",
+        )
+        assert autotune.lookup("tpu v5e", 65536, 128, 2048, "int32") == geom
+        # other shapes / devices miss
+        assert autotune.lookup("tpu v5e", 65536, 128, 4096, "int32") is None
+        assert autotune.lookup("tpu v4", 65536, 128, 2048, "int32") is None
+        # provenance rides along in the file
+        entry = json.load(open(cache))[
+            autotune.make_key("tpu v5e", 65536, 128, 2048, "int32")
+        ]
+        assert entry["elem_per_sec"] == 1.5e10
+        assert entry["source"] == "unit"
+
+    def test_record_if_better_keeps_winners(self, cache):
+        a = autotune.Geometry(64, 0, 512)
+        b = autotune.Geometry(64, 1024, 512)
+        assert autotune.record_if_better(
+            "cpu", 8, 4, 16, "int32", a, elem_per_sec=1e9
+        )
+        # slower challenger is rejected
+        assert not autotune.record_if_better(
+            "cpu", 8, 4, 16, "int32", b, elem_per_sec=5e8
+        )
+        assert autotune.lookup("cpu", 8, 4, 16, "int32") == a
+        # faster challenger wins
+        assert autotune.record_if_better(
+            "cpu", 8, 4, 16, "int32", b, elem_per_sec=2e9
+        )
+        assert autotune.lookup("cpu", 8, 4, 16, "int32") == b
+
+    def test_corrupt_cache_degrades_to_defaults(self, cache):
+        with open(cache, "w") as f:
+            f.write("{not json")
+        assert autotune.lookup("cpu", 8, 4, 16, "int32") is None
+        # and recording over a corrupt file rewrites it cleanly
+        autotune.record(
+            "cpu", 8, 4, 16, "int32", autotune.Geometry(8, 8, 4)
+        )
+        assert autotune.lookup("cpu", 8, 4, 16, "int32") == autotune.Geometry(
+            8, 8, 4
+        )
+
+    def test_mtime_memo_sees_rewrites(self, cache):
+        autotune.record("cpu", 8, 4, 16, "int32", autotune.Geometry(8, 0, 0))
+        assert autotune.lookup("cpu", 8, 4, 16, "int32").block_r == 8
+        autotune.record("cpu", 8, 4, 16, "int32", autotune.Geometry(4, 0, 0))
+        assert autotune.lookup("cpu", 8, 4, 16, "int32").block_r == 4
+
+
+class TestEngineConsumption:
+    R, k, B = 16, 8, 64
+
+    def _engine(self, impl):
+        return ReservoirEngine(
+            SamplerConfig(
+                max_sample_size=self.k,
+                num_reservoirs=self.R,
+                tile_size=self.B,
+                impl=impl,
+            ),
+            key=0,
+        )
+
+    def _tile(self):
+        rng = np.random.default_rng(3)
+        return rng.integers(1, 1 << 30, (self.R, self.B)).astype(np.int32)
+
+    def test_absent_cache_uses_kernel_defaults(self, cache):
+        e = self._engine("pallas")
+        e.sample(self._tile())
+        assert e.pallas_used()
+        assert list(e._geometry_by_key.values()) == [None]
+
+    def test_planted_entry_changes_selected_geometry(self, cache):
+        import jax
+
+        planted = autotune.Geometry(8, 16, 8)
+        autotune.record(
+            jax.devices()[0].device_kind, self.R, self.k, self.B, "int32",
+            planted,
+        )
+        e_pl = self._engine("pallas")
+        e_xla = self._engine("xla")
+        tile = self._tile()
+        e_pl.sample(tile)
+        e_xla.sample(tile)
+        assert list(e_pl._geometry_by_key.values()) == [planted]
+        # the tuned geometry is still bit-identical to the XLA path
+        np.testing.assert_array_equal(
+            np.asarray(e_pl._state.samples), np.asarray(e_xla._state.samples)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(e_pl._state.nxt), np.asarray(e_xla._state.nxt)
+        )
+
+    def test_fused_stream_consumes_cache_too(self, cache):
+        import jax
+
+        planted = autotune.Geometry(8, 16, 8)
+        autotune.record(
+            jax.devices()[0].device_kind, self.R, self.k, self.B, "int32",
+            planted,
+        )
+        e_pl = self._engine("pallas")
+        e_xla = self._engine("xla")
+        rng = np.random.default_rng(5)
+        stream = rng.integers(1, 1 << 30, (self.R, 4 * self.B)).astype(
+            np.int32
+        )
+        e_pl.sample_stream(stream, fused=True)
+        e_xla.sample_stream(stream, fused=True)
+        fused_keys = [
+            key for key in e_pl._geometry_by_key if key[0] == "stream_fused"
+        ]
+        assert fused_keys
+        assert all(
+            e_pl._geometry_by_key[key] == planted for key in fused_keys
+        )
+        np.testing.assert_array_equal(
+            np.asarray(e_pl._state.samples), np.asarray(e_xla._state.samples)
+        )
+
+    def test_non_algl_modes_ignore_cache(self, cache):
+        import jax
+
+        autotune.record(
+            jax.devices()[0].device_kind, self.R, self.k, self.B, "int32",
+            autotune.Geometry(8, 16, 8),
+        )
+        e = ReservoirEngine(
+            SamplerConfig(
+                max_sample_size=self.k,
+                num_reservoirs=self.R,
+                tile_size=self.B,
+                weighted=True,
+                impl="pallas",
+            ),
+            key=0,
+        )
+        rng = np.random.default_rng(7)
+        e.sample(
+            self._tile(),
+            weights=rng.uniform(0.1, 2.0, (self.R, self.B)).astype(
+                np.float32
+            ),
+        )
+        assert list(e._geometry_by_key.values()) == [None]

@@ -14,6 +14,11 @@ import jax.random as jr
 import numpy as np
 import pytest
 
+try:  # jax >= 0.5 spells it jax.enable_x64
+    _enable_x64 = jax.enable_x64
+except AttributeError:  # 0.4.x: jax.experimental.enable_x64
+    from jax.experimental import enable_x64 as _enable_x64
+
 from reservoir_tpu import SamplerConfig
 from reservoir_tpu.engine import ReservoirEngine
 from reservoir_tpu.errors import SamplerClosedError
@@ -154,12 +159,12 @@ def test_restore_refuses_dtype_narrowing(tmp_path):
     # int64 counters saved under x64 must not silently narrow to int32 in an
     # x64-off process.
     path = str(tmp_path / "x64.npz")
-    with jax.enable_x64(True):
+    with _enable_x64(True):
         state = al.init(jr.key(0), 2, 2, count_dtype=jnp.int64)
         save_state(path, state)
     assert not jax.config.jax_enable_x64
     with pytest.raises(ValueError, match="narrow"):
         load_state(path)
-    with jax.enable_x64(True):
+    with _enable_x64(True):
         st = load_state(path)  # x64 on: restores fine
         assert st.count.dtype == jnp.int64

@@ -66,32 +66,52 @@ def test_fuzz_distinct(R, k, B, steps):
     _eq(s_ref, s_pal, ("values", "hash_hi", "hash_lo", "size", "count"))
 
 
+def _rand_chunk_b(B: int, seed: int) -> int:
+    """A random divisor-chunk of B (or a non-divisor — the kernel's
+    full-tile fallback — ~1 time in 4): the 2-D grid decomposition is
+    fuzzed together with the shapes."""
+    rng = np.random.default_rng(seed)
+    divisors = [d for d in range(1, B + 1) if B % d == 0]
+    if rng.random() < 0.25:
+        return int(rng.integers(1, B + 2))  # may or may not divide B
+    return int(divisors[rng.integers(0, len(divisors))])
+
+
 @pytest.mark.parametrize("R,k,B,steps", _CASES)
 def test_fuzz_algl_fill(R, k, B, steps):
     # the fill-capable kernel (r4) from an EMPTY state: random (k, B)
     # relations place the fill->steady boundary at tile starts, mid-tile,
     # and across several tiles — the count-offset fill scatter
     # (dest = count + lane) and the same-tile fill-then-accept handoff
-    # are exactly the cases the hand-picked suites can't enumerate
+    # are exactly the cases the hand-picked suites can't enumerate.
+    # chunk_b is fuzzed too: the boundary must land identically in every
+    # grid decomposition
     s_ref = s_pal = al.init(jr.key(R * 1000 + k + 3), R, k)
+    chunk_b = _rand_chunk_b(B, R * 31 + k)
     for step in range(steps + 1):  # +1: guarantee the boundary is crossed
         key = jr.fold_in(jr.key(13), step)
         b = jr.randint(key, (R, B), 0, 1 << 30, jnp.int32)
         s_ref = al.update(s_ref, b)
-        s_pal = alp.update_pallas(s_pal, b, block_r=8, interpret=True)
+        s_pal = alp.update_pallas(
+            s_pal, b, block_r=8, chunk_b=chunk_b, interpret=True
+        )
     _eq(s_ref, s_pal, ("samples", "count", "nxt", "log_w"))
 
 
 @pytest.mark.parametrize("R,k,B,steps", _CASES)
 def test_fuzz_algl_steady(R, k, B, steps):
-    # steady-state-only kernel entry: fill first via the XLA path
+    # steady-state-only kernel entry: fill first via the XLA path;
+    # random (block_r, chunk_b) grid decomposition per case
     s = al.init(jr.key(R * 1000 + k + 2), R, k)
     fill = jax.lax.broadcasted_iota(jnp.int32, (R, max(B, k)), 1)
     s = al.update(s, fill)
     s_ref = s_pal = s
+    chunk_b = _rand_chunk_b(B, R * 37 + k)
     for step in range(steps):
         key = jr.fold_in(jr.key(11), step)
         b = jr.randint(key, (R, B), 0, 1 << 30, jnp.int32)
         s_ref = al.update_steady(s_ref, b)
-        s_pal = alp.update_steady_pallas(s_pal, b, block_r=8, interpret=True)
+        s_pal = alp.update_steady_pallas(
+            s_pal, b, block_r=8, chunk_b=chunk_b, interpret=True
+        )
     _eq(s_ref, s_pal, ("samples", "count", "nxt", "log_w"))

@@ -5,10 +5,16 @@ This equality is the foundation of the Pallas/vmap bit-equivalence story
 uses ops.threefry — these tests prove that is the *same* RNG, not a lookalike.
 """
 
+import jax
 import jax.numpy as jnp
 import jax.random as jr
 import numpy as np
 import pytest
+
+try:  # jax >= 0.5 spells it jax.enable_x64
+    _enable_x64 = jax.enable_x64
+except AttributeError:  # 0.4.x: jax.experimental.enable_x64
+    from jax.experimental import enable_x64 as _enable_x64
 
 from reservoir_tpu.ops import threefry as tf
 
@@ -55,7 +61,7 @@ def test_fold_in_64bit_no_wraparound():
 
     key = jr.key(9)
     k1, k2 = _words(key)
-    with jax.enable_x64(True):
+    with _enable_x64(True):
         lo = jnp.asarray(12345, jnp.int64)
         hi = lo + (jnp.asarray(1, jnp.int64) << 32)
         a = np.stack(tf.fold_in_words(k1, k2, lo))

@@ -19,6 +19,11 @@ import jax.random as jr
 import numpy as np
 import pytest
 
+try:  # jax >= 0.5 spells it jax.enable_x64
+    _enable_x64 = jax.enable_x64
+except AttributeError:  # 0.4.x: jax.experimental.enable_x64
+    from jax.experimental import enable_x64 as _enable_x64
+
 from reservoir_tpu.ops import algorithm_l as al
 from reservoir_tpu.ops import u64e
 
@@ -133,7 +138,7 @@ class TestWideOps:
         for t in tiles:
             sw = al.update_steady(sw, t)
 
-        with jax.enable_x64(True):
+        with _enable_x64(True):
             s64 = _lift_int64(base, shift)
             for t in tiles:
                 s64 = al.update_steady(s64, t)
@@ -273,7 +278,7 @@ class TestWideMergeInt64Parity:
         sw, cw = al.merge_samples(s_a, c_a_w, s_b, c_b_w, key)
         from_a_wide = (np.asarray(sw) > 0) & (np.asarray(sw) < 1_000_000)
 
-        with jax.enable_x64(True):
+        with _enable_x64(True):
             si, ci = al.merge_samples(
                 s_a, jnp.asarray(counts_a, jnp.int64),
                 s_b, jnp.asarray(counts_b, jnp.int64), key,

@@ -47,6 +47,7 @@ PUBLIC_MODULES = [
     "reservoir_tpu.errors",
     "reservoir_tpu.ops.algorithm_l",
     "reservoir_tpu.ops.algorithm_l_pallas",
+    "reservoir_tpu.ops.autotune",
     "reservoir_tpu.ops.distinct",
     "reservoir_tpu.ops.distinct_pallas",
     "reservoir_tpu.ops.hashing",
@@ -79,6 +80,14 @@ def _sig(obj) -> str:
 
 
 def _describe(obj) -> object:
+    import typing
+
+    if obj is typing.Any:
+        # typing.Any's introspection identity moved across Python versions
+        # (special form -> class in 3.11+); pin one stable descriptor so
+        # the manifest doesn't churn with the interpreter that ran the
+        # generator
+        return {"kind": "class", "methods": {}}
     if inspect.isclass(obj):
         methods = {}
         for name, member in sorted(vars(obj).items()):
@@ -109,9 +118,65 @@ def build_manifest() -> dict:
     return out
 
 
+def _split_params(sig: str) -> "tuple[list, str]":
+    """Top-level parameter strings + return annotation of a rendered
+    signature.  Splits on commas outside brackets/quotes (annotations like
+    ``"'int | None'"`` and tuple defaults stay whole)."""
+    body, _, ret = sig.partition(" -> ")
+    body = body.strip()
+    if not (body.startswith("(") and body.endswith(")")):
+        return [sig], ret
+    parts, cur, depth, quote = [], "", 0, None
+    for ch in body[1:-1]:
+        if quote:
+            if ch == quote:
+                quote = None
+        elif ch in "\"'":
+            quote = ch
+        elif ch in "([{":
+            depth += 1
+        elif ch in ")]}":
+            depth -= 1
+        elif ch == "," and depth == 0:
+            parts.append(cur.strip())
+            cur = ""
+            continue
+        cur += ch
+    if cur.strip():
+        parts.append(cur.strip())
+    return parts, ret
+
+
+def _signature_compatible(old_sig: str, new_sig: str) -> bool:
+    """Whether ``new_sig`` can serve every call ``old_sig`` accepted: the
+    old parameters survive verbatim in order, the return annotation is
+    unchanged, and anything new is a keyword-only parameter with a default
+    — the Python analog of a binary-compatible addition, which the MiMa
+    policy ('additions are fine') must not flag."""
+    old_params, old_ret = _split_params(old_sig)
+    new_params, new_ret = _split_params(new_sig)
+    if old_ret != new_ret:
+        return False
+    star = new_params.index("*") if "*" in new_params else len(new_params)
+    it = iter(enumerate(new_params))
+    for p in old_params:
+        for i, q in it:
+            if q == p:
+                break
+        else:
+            return False  # an old parameter vanished or changed
+    for i, q in enumerate(new_params):
+        if q in old_params or q == "*":
+            continue
+        if i < star or "=" not in q:
+            return False  # positional or default-less addition
+    return True
+
+
 def check_backward_compat(baseline: dict, current: dict) -> list:
     """MiMa-semantics check against a RELEASED baseline manifest: additions
-    are fine; any removal or signature change of a released export breaks
+    are fine (including new keyword-only parameters with defaults); any
+    removal or incompatible signature change of a released export breaks
     compatibility (the reference checks released artifacts the same way,
     ``build.sbt:58-68,124-125``)."""
     errors = []
@@ -135,11 +200,21 @@ def check_backward_compat(baseline: dict, current: dict) -> list:
                     cm = cur.get("methods", {}).get(m)
                     if cm is None:
                         errors.append(f"method removed: {mod}.{name}.{m}")
-                    elif cm != sig:
+                    elif cm != sig and not _signature_compatible(sig, cm):
                         errors.append(
                             f"method changed: {mod}.{name}.{m}: {sig} -> {cm}"
                         )
             elif cur != desc:
+                if (
+                    isinstance(desc, dict)
+                    and isinstance(cur, dict)
+                    and desc.get("kind") == "function"
+                    and cur.get("kind") == "function"
+                    and _signature_compatible(
+                        desc.get("signature", ""), cur.get("signature", "")
+                    )
+                ):
+                    continue  # compatible keyword-only additions
                 errors.append(f"changed: {mod}.{name}: {desc} -> {cur}")
     return errors
 

@@ -1,17 +1,18 @@
-"""Re-capture the headline algl bench at the best swept (block, chunk).
+"""Re-capture the headline algl bench at the best swept geometry.
 
 Runs as the watcher's final post-step (sequentially gated: only after
 ``tpu_algl_block_sweep.py`` completed this run), reading the per-variant
 compile/throughput records it appended to ``TPU_BLOCK_SWEEP.jsonl``:
-pick the (block_r, chunk_b) variant with the highest steady-state
-throughput among variants that compiled sanely (compile+first-run under
-``--max-compile-s``), and — if it differs from the bench default
-(block 64, chunk 512) — run one more ``bench.py`` algl capture with
-``RESERVOIR_BENCH_BLOCK_R``/``RESERVOIR_ALGL_CHUNK_B`` set, via the
-watcher's own ``capture_bench`` (same timeout-salvage, same capture
-file).  This turns one hardware window into both the sweep evidence AND
-a headline number at the sweep's winner (VERDICT r3 item 2a), with no
-second window.
+pick the ``(block_r, chunk_b, gather_chunk)`` geometry with the highest
+steady-state throughput among variants that compiled sanely
+(compile+first-run under ``--max-compile-s``), refresh the persistent
+autotune cache with it (:mod:`reservoir_tpu.ops.autotune` — the cache the
+engine and bench consult at jit time), and — if it differs from the bench
+default (block 64, whole-tile chunk, gather 512) — run one more
+``bench.py`` algl capture with the geometry env-pinned, via the watcher's
+own ``capture_bench`` (same timeout-salvage, same capture file).  This
+turns one hardware window into the sweep evidence AND a headline number at
+the sweep's winner (VERDICT r3 item 2a), with no second window.
 
 Only records stamped at/after ``--since`` (default: the watcher's
 ``TPU_WATCH_RUN_START`` env) count — the sweep file is append-only
@@ -33,17 +34,38 @@ import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 SWEEP = os.path.join(REPO, "TPU_BLOCK_SWEEP.jsonl")
-DEFAULT = (64, 512)  # bench.py's RESERVOIR_BENCH_BLOCK_R / kernel chunk
+# bench.py's defaults: RESERVOIR_BENCH_BLOCK_R=64, whole-tile streaming
+# chunk, gather window 512
+DEFAULT = (64, 0, 512)
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def _variant_of(res: dict) -> "tuple[int, int, int]":
+    """(block_r, chunk_b, gather_chunk) from a sweep result record.
+
+    Pre-r6 records carry no ``gather_chunk`` field: their ``chunk_b`` WAS
+    the gather window (streaming chunks didn't exist yet), and records
+    older still carry neither (full-width gathers).  The since-gate
+    normally excludes both; this mapping just keeps accidental reads
+    faithful."""
+    if "gather_chunk" in res:
+        return (
+            res["block_r"],
+            res.get("chunk_b", 0),
+            res["gather_chunk"],
+        )
+    return res["block_r"], 0, res.get("chunk_b", 0)
 
 
 def pick_best(
     max_compile_s: float, since: str
-) -> "tuple[tuple[int, int], float] | None":
-    """((block_r, chunk_b), elem_per_sec) of the best sanely-compiling
-    variant, from the LATEST record per variant stamped >= ``since`` (ISO
-    timestamps compare lexicographically); None without usable data."""
+) -> "tuple[tuple[int, int, int], float, dict] | None":
+    """((block_r, chunk_b, gather_chunk), elem_per_sec, result_record) of
+    the best sanely-compiling variant, from the LATEST record per variant
+    stamped >= ``since`` (ISO timestamps compare lexicographically); None
+    without usable data."""
     if not os.path.exists(SWEEP):
         return None
     per_variant: dict = {}
@@ -58,16 +80,12 @@ def pick_best(
             res = rec.get("result")
             if not res or res.get("compile_plus_first_run_s", 1e9) > max_compile_s:
                 continue
-            # pre-r4 records carry no chunk_b: those measured the then-
-            # current FULL-WIDTH kernel (chunking landed in r4), so the
-            # faithful default is 0 — the since-gate normally excludes
-            # them anyway
-            variant = (res["block_r"], res.get("chunk_b", 0))
-            per_variant[variant] = res["elem_per_sec"]
+            per_variant[_variant_of(res)] = (res["elem_per_sec"], res)
     if not per_variant:
         return None
-    best = max(per_variant, key=per_variant.get)  # ties: any
-    return best, per_variant[best]
+    best = max(per_variant, key=lambda v: per_variant[v][0])  # ties: any
+    rate, res = per_variant[best]
+    return best, rate, res
 
 
 def main() -> int:
@@ -86,33 +104,59 @@ def main() -> int:
             flush=True,
         )
         return 1
-    (block, chunk), rate = best
-    if (block, chunk) == DEFAULT:
+    (block, chunk, gather), rate, res = best
+    if res.get("device_kind"):
+        # make the winner the engine's live geometry for this device+shape
+        from reservoir_tpu.ops import autotune
+
+        refreshed = autotune.record_if_better(
+            res["device_kind"],
+            res.get("R", 65536),
+            res.get("k", 128),
+            res.get("B", 2048),
+            "int32",
+            autotune.Geometry(block, chunk, gather),
+            elem_per_sec=rate,
+            source="tpu_algl_best_block",
+        )
         print(
-            f"default block {block} chunk {chunk} is already the sweep "
-            f"winner ({rate:.3g} elem/s)",
+            f"autotune cache {'refreshed' if refreshed else 'already best'}: "
+            f"block {block} chunk {chunk} gather {gather}",
+            flush=True,
+        )
+    if (block, chunk, gather) == DEFAULT:
+        print(
+            f"default geometry {DEFAULT} is already the sweep winner "
+            f"({rate:.3g} elem/s)",
             flush=True,
         )
         return 0
     print(
-        f"sweep winner: block {block} chunk {chunk} ({rate:.3g} elem/s); "
-        "re-capturing headline",
+        f"sweep winner: block {block} chunk {chunk} gather {gather} "
+        f"({rate:.3g} elem/s); re-capturing headline",
         flush=True,
     )
     from tpu_watch import capture_bench
 
     status = capture_bench(
-        f"algl_block{block}_chunk{chunk}",
+        f"algl_block{block}_chunk{chunk}_g{gather}",
         bench_config="algl",
         extra_env={
-            # the selftest child inherits both knobs, so the winner's
+            # the selftest child inherits all three knobs, so the winner's
             # headline row carries parity+KS proven at the exact kernel
-            # shape that produced the number
+            # geometry that produced the number; the STREAM_CHUNK env is
+            # the kernel-level default the selftest's own pallas calls read
             "RESERVOIR_BENCH_BLOCK_R": str(block),
-            "RESERVOIR_ALGL_CHUNK_B": str(chunk),
+            "RESERVOIR_BENCH_CHUNK_B": str(chunk),
+            "RESERVOIR_ALGL_STREAM_CHUNK": str(chunk),
+            "RESERVOIR_ALGL_CHUNK_B": str(gather),
         },
     )
-    print(f"re-capture at block {block} chunk {chunk}: {status}", flush=True)
+    print(
+        f"re-capture at block {block} chunk {chunk} gather {gather}: "
+        f"{status}",
+        flush=True,
+    )
     return 0 if status == "ok" else 1
 
 

@@ -1,18 +1,23 @@
-"""Algorithm-L Pallas block/chunk sweep on the live TPU (VERDICT r2 item 4).
+"""Algorithm-L Pallas geometry sweep on the live TPU (VERDICT r2 item 4).
 
 Round 2 found block_r > 64 blew up Mosaic compile (>6 min, killed); the
-kernel has since been restructured (chunked one-hot gathers).  Round 4 adds
-the chunk-width axis: the captured headline at block 64 came in ~25% under
-r3's full-width-gather number, so each variant is a (block_r, chunk_b)
-pair — chunk 0 = full-width gathers, the pre-r4 shape.  This script
-measures, per variant, compile wall time and steady-state throughput —
-each in a THROWAWAY subprocess with a hard timeout, so a compile blowup
-costs its timeout and is recorded, never inherited.  Appends JSON lines to
-``TPU_BLOCK_SWEEP.jsonl``.
+kernel has since been restructured twice: chunked one-hot gathers (r4) and
+the 2-D grid-pipelined batch streaming (r6), so each variant is now a full
+``(block_r, chunk_b, gather_chunk)`` geometry — ``chunk_b`` the
+batch-streaming chunk of the grid pipeline (0 = whole tile, the pre-r6
+shape) and ``gather_chunk`` the one-hot select window (0 = full-width, the
+pre-r4 shape).  This script measures, per variant, compile wall time and
+steady-state throughput — each in a THROWAWAY subprocess with a hard
+timeout, so a compile blowup costs its timeout and is recorded, never
+inherited.  Appends JSON lines to ``TPU_BLOCK_SWEEP.jsonl`` AND records
+each sanely-compiling variant into the persistent autotune cache
+(:mod:`reservoir_tpu.ops.autotune`, best-rate-wins) — the cache the engine
+and bench consult at jit time, so a sweep winner becomes the live geometry
+without a code change.
 
 Usage (only sensible against a live TPU backend):
-    python tools/tpu_algl_block_sweep.py [--variants 64:512,64:0,128:512]
-                                         [--timeout 420]
+    python tools/tpu_algl_block_sweep.py \
+        [--variants 64:0:512,64:1024:512,128:1024:512] [--timeout 420]
 """
 
 from __future__ import annotations
@@ -27,12 +32,16 @@ import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 OUT = os.path.join(REPO, "TPU_BLOCK_SWEEP.jsonl")
+# sweep shape = the headline bench config (BASELINE.md)
+SWEEP_R, SWEEP_K, SWEEP_B = 65536, 128, 2048
+# compile-sanity bound for cache admission: a variant that took longer
+# than this to compile+first-run is recorded in the JSONL but never
+# becomes the engine's live geometry
+MAX_CACHE_COMPILE_S = 120.0
 
 _CHILD = r"""
 import json, os, sys, time
-block_r = int(sys.argv[1])
-# must land in the env BEFORE the kernel module import reads it
-os.environ["RESERVOIR_ALGL_CHUNK_B"] = sys.argv[2]
+block_r = int(sys.argv[1]); chunk_b = int(sys.argv[2]); gather = int(sys.argv[3])
 import jax, jax.numpy as jnp, jax.random as jr
 import functools
 R, k, B, steps = 65536, 128, 2048, 50
@@ -40,7 +49,12 @@ from reservoir_tpu.ops import algorithm_l as al
 from reservoir_tpu.ops import algorithm_l_pallas as alp
 state = al.init(jr.key(0), R, k)
 state = al.update(state, jax.lax.broadcasted_iota(jnp.int32, (R, B), 1))
-step_fn = functools.partial(alp.update_steady_pallas, block_r=block_r)
+step_fn = functools.partial(
+    alp.update_steady_pallas,
+    block_r=block_r or None,
+    chunk_b=chunk_b or None,
+    gather_chunk=gather,
+)
 
 @functools.partial(jax.jit, donate_argnums=0)
 def run(state, step0):
@@ -64,34 +78,56 @@ for r in (1, 2):
     times.append(time.perf_counter() - t0)
 print(json.dumps({
     "block_r": block_r,
-    "chunk_b": int(sys.argv[2]),
+    "chunk_b": chunk_b,
+    "gather_chunk": gather,
     "compile_plus_first_run_s": round(compile_s, 2),
     "elem_per_sec": R * B * steps / min(times),
+    "device_kind": jax.devices()[0].device_kind,
+    "R": R, "k": k, "B": B,
 }))
 """
+
+
+def _parse_variant(variant: str) -> "tuple[int, int, int]":
+    """``block[:chunk[:gather]]`` -> (block_r, chunk_b, gather_chunk).
+    Two-part legacy form ``block:gather`` (pre-r6 sweeps had no streaming
+    chunk) maps to chunk_b=0."""
+    parts = [int(p) for p in variant.split(":")]
+    if len(parts) == 1:
+        return parts[0], 0, 512
+    if len(parts) == 2:
+        return parts[0], 0, parts[1]
+    return parts[0], parts[1], parts[2]
 
 
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument(
         "--variants",
-        default="64:512,64:0,128:512,128:0",
-        help="comma-separated block_r:chunk_b pairs (chunk 0 = full-width)",
+        # the proven default first; then the grid-pipeline chunks at the
+        # proven block, then the block-128 question behind chunking
+        default="64:0:512,64:1024:512,64:512:512,64:256:512,128:1024:512",
+        help="comma-separated block_r:chunk_b:gather_chunk geometries "
+        "(chunk 0 = whole tile, gather 0 = full-width)",
     )
     ap.add_argument("--timeout", type=float, default=420.0)
     args = ap.parse_args()
+    sys.path.insert(0, REPO)
+    from reservoir_tpu.ops import autotune
+
     for variant in args.variants.split(","):
-        blk, _, chunk = variant.partition(":")
-        chunk = chunk or "512"
+        blk, chunk, gather = _parse_variant(variant)
         t0 = time.time()
         rec = {
             "ts": datetime.datetime.now(datetime.timezone.utc).isoformat(),
-            "block_r": int(blk),
-            "chunk_b": int(chunk),
+            "block_r": blk,
+            "chunk_b": chunk,
+            "gather_chunk": gather,
         }
         try:
             proc = subprocess.run(
-                [sys.executable, "-c", _CHILD, blk, chunk],
+                [sys.executable, "-c", _CHILD, str(blk), str(chunk),
+                 str(gather)],
                 capture_output=True,
                 timeout=args.timeout,
                 text=True,
@@ -109,6 +145,24 @@ def main() -> int:
         except subprocess.TimeoutExpired:
             rec["rc"] = "timeout"
             rec["wall_s"] = round(time.time() - t0, 1)
+        res = rec.get("result")
+        if (
+            res
+            and res.get("compile_plus_first_run_s", 1e9) <= MAX_CACHE_COMPILE_S
+            and res.get("device_kind")
+        ):
+            # best-rate-wins: the cache ends the sweep holding the fastest
+            # sanely-compiling geometry for this device+shape
+            rec["cached"] = autotune.record_if_better(
+                res["device_kind"],
+                res.get("R", SWEEP_R),
+                res.get("k", SWEEP_K),
+                res.get("B", SWEEP_B),
+                "int32",
+                autotune.Geometry(blk, chunk, gather),
+                elem_per_sec=res["elem_per_sec"],
+                source="tpu_algl_block_sweep",
+            )
         with open(OUT, "a") as f:
             f.write(json.dumps(rec) + "\n")
         print(rec, flush=True)

@@ -105,11 +105,6 @@ def report(rows) -> str:
     a = best.get("algl")
     b = best.get("algl_chunk0")
     if a and b:
-        va, vb = a[2]["value"], b[2]["value"]
-        winner = "CHUNK_B=512 (chunked, current default)" if va >= vb else (
-            "CHUNK_B=0 (full-width) — flip _GATHER_CHUNK_B default in "
-            "ops/algorithm_l_pallas.py"
-        )
         out.append("")
         if a[0] != b[0]:
             out.append(
@@ -118,6 +113,15 @@ def report(rows) -> str:
                 "re-capture both in one window before acting."
             )
         else:
+            # winner/gap are only computed on a same-file comparison — a
+            # cross-file pair must never produce a prescription (ADVICE r5)
+            va, vb = a[2]["value"], b[2]["value"]
+            winner = (
+                "CHUNK_B=512 (chunked, current default)" if va >= vb else (
+                    "CHUNK_B=0 (full-width) — flip _GATHER_CHUNK_B default "
+                    "in ops/algorithm_l_pallas.py"
+                )
+            )
             out.append(
                 f"Chunk A/B [{a[0]}]: default {va:.3e} vs chunk0 {vb:.3e} "
                 f"({(max(va, vb) / max(min(va, vb), 1e-12) - 1) * 100:.1f}% "

@@ -49,6 +49,11 @@ CONFIG_BUDGETS: dict[str, tuple[float, dict[str, str]]] = {
     # amortizes per-tile overheads; selftest off — parity for the kernel
     # rides the algl row, this is a shape probe
     "algl_B4096": (600.0, {"RESERVOIR_BENCH_SELFTEST": "0"}),
+    # the r6 grid-pipelined kernel: stream the batch through VMEM in
+    # 1024-wide chunks (Mosaic double-buffers the HBM reads against the
+    # acceptance loop) — the direct A/B for the roofline restructure,
+    # ahead of the full geometry sweep; selftest off, parity rides algl
+    "algl_chunk1024": (600.0, {"RESERVOIR_BENCH_SELFTEST": "0"}),
     # bench defaults the selftest to the algl config only — the distinct/
     # weighted captures must opt IN so their rows carry embedded parity +
     # their own KS gates (VERDICT r4 items 3 and 6)
@@ -78,7 +83,7 @@ CONFIG_BUDGETS: dict[str, tuple[float, dict[str, str]]] = {
 # captured in r4.  Module-level so tests can assert every entry carries
 # a CONFIG_BUDGETS row (an unbudgeted config can burn a whole window).
 DEFAULT_CONFIGS = (
-    "algl,algl_chunk0,distinct,weighted,stream,bridge,"
+    "algl,algl_chunk1024,algl_chunk0,distinct,weighted,stream,bridge,"
     "bridge_serial,algl_B4096"
 )
 
@@ -132,7 +137,11 @@ def capture_bench(
     # the pre-r4 kernel shape) for the 25%-regression A/B (r4 item 2).
     budget = CONFIG_BUDGETS.get(config)
     if budget is not None:
-        timeout_s = min(timeout_s, budget[0])
+        # TPU_WATCH_BUDGET_SCALE shrinks every budget proportionally — the
+        # dry-rehearsal knob (VERDICT r5 weak item 6), so the scheduler can
+        # be driven end-to-end against a simulated short window
+        scale = float(os.environ.get("TPU_WATCH_BUDGET_SCALE", "1") or 1)
+        timeout_s = min(timeout_s, budget[0] * scale)
         extra_env = {**budget[1], **(extra_env or {})}
     else:
         extra_env = dict(extra_env or {})
@@ -147,6 +156,9 @@ def capture_bench(
         elif config == "algl_B4096":
             bench_config = "algl"
             extra_env.setdefault("RESERVOIR_BENCH_B", "4096")
+        elif config == "algl_chunk1024":
+            bench_config = "algl"
+            extra_env.setdefault("RESERVOIR_BENCH_CHUNK_B", "1024")
     env = dict(os.environ, RESERVOIR_BENCH_CONFIG=bench_config, **extra_env)
     t0 = time.time()
     try:
@@ -175,15 +187,18 @@ def capture_bench(
                     salvaged = json.loads(line)
                 except json.JSONDecodeError:
                     pass
-        _append(
-            {
-                "ts": _now(),
-                "config": config,
-                "rc": "timeout",
-                "wall_s": round(time.time() - t0, 1),
-                "result": salvaged,
-            }
-        )
+        rec = {
+            "ts": _now(),
+            "config": config,
+            "rc": "timeout",
+            "wall_s": round(time.time() - t0, 1),
+            "result": salvaged,
+        }
+        if isinstance(salvaged, dict) and isinstance(
+            salvaged.get("geometry"), dict
+        ):
+            rec["geometry"] = salvaged["geometry"]
+        _append(rec)
         # a healthy bench cannot hang past its own probe guard — a
         # timeout means the tunnel dropped mid-run; stop burning the window
         return "ok" if salvaged else "unreachable"
@@ -195,16 +210,20 @@ def capture_bench(
                 parsed = json.loads(line)
             except json.JSONDecodeError:
                 pass
-    _append(
-        {
-            "ts": _now(),
-            "config": config,
-            "rc": proc.returncode,
-            "wall_s": round(time.time() - t0, 1),
-            "result": parsed,
-            "stderr_tail": proc.stderr[-2000:],
-        }
-    )
+    rec = {
+        "ts": _now(),
+        "config": config,
+        "rc": proc.returncode,
+        "wall_s": round(time.time() - t0, 1),
+        "result": parsed,
+        "stderr_tail": proc.stderr[-2000:],
+    }
+    if isinstance(parsed, dict) and isinstance(parsed.get("geometry"), dict):
+        # surface the tuned (block_r, chunk_b, gather_chunk) at the row's
+        # top level: evidence rows must say which kernel geometry produced
+        # the number without digging through the bench JSON
+        rec["geometry"] = parsed["geometry"]
+    _append(rec)
     if proc.returncode != 0 or parsed is None:
         if "backend unreachable" in proc.stderr:
             return "unreachable"
@@ -324,6 +343,31 @@ POST_STEPS: list[tuple[str, list[str], float, dict]] = [
 ]
 
 
+def run_window(remaining: "list[str]") -> "tuple[list[str], list[str], bool]":
+    """One open hardware window: attempt every remaining config under its
+    per-config wall budget.  Returns ``(captured, still_remaining,
+    dropped)`` — ``dropped`` means the tunnel died mid-window and the rest
+    of the queue was carried over untried.  Extracted from the watch loop
+    so the budget scheduler can be rehearsed against a simulated window
+    (``tests/test_tpu_watch.py``) without hardware."""
+    still: "list[str]" = []
+    dropped = False
+    for i, c in enumerate(remaining):
+        status = capture_bench(c)
+        print(f"[{_now()}] capture {c}: {status}", flush=True)
+        if status == "ok":
+            continue
+        still.append(c)
+        if status == "unreachable":
+            # tunnel dropped mid-window: don't burn ~15 min of
+            # probe/backoff per remaining config on a dead backend
+            still.extend(remaining[i + 1 :])
+            dropped = True
+            break
+    captured = [c for c in remaining if c not in still]
+    return captured, still, dropped
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--max-hours", type=float, default=12.0)
@@ -352,24 +396,10 @@ def main() -> int:
         if platform == "tpu":
             print(f"[{stamp}] tpu UP after {attempt} probes", flush=True)
             _append({"ts": stamp, "event": "tpu_up", "probes": attempt})
-            still = []
-            dropped = False
-            for i, c in enumerate(remaining):
-                status = capture_bench(c)
-                print(f"[{_now()}] capture {c}: {status}", flush=True)
-                if status == "ok":
-                    continue
-                still.append(c)
-                if status == "unreachable":
-                    # tunnel dropped mid-window: don't burn ~15 min of
-                    # probe/backoff per remaining config on a dead backend
-                    still.extend(remaining[i + 1 :])
-                    dropped = True
-                    break
             # THIS window's captures (entry snapshot minus what's left):
             # the commit message is the durable record of which window
             # produced which rows
-            captured = [c for c in remaining if c not in still]
+            captured, still, dropped = run_window(remaining)
             total = len([c for c in args.configs.split(",") if c])
             remaining = still
             _commit_capture(
