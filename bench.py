@@ -39,7 +39,11 @@ Env knobs:
                                 backend; transfer = RAW device_put
                                 bandwidth at the bridge tile shape, the
                                 wire ceiling for the bridge row)
-  RESERVOIR_BENCH_BLOCK_R       algl Pallas row-block (default 64; 0 = auto)
+  RESERVOIR_BENCH_BLOCK_R       Pallas row-block override for the active
+                                config's kernel (algl default 64, others
+                                auto; 0 = auto)
+  RESERVOIR_BENCH_CHUNK_B       Pallas batch-streaming chunk override for
+                                the active config's kernel (0 = whole tile)
   RESERVOIR_BENCH_BRIDGE_PIPELINED  1 (default) double-buffered bridge;
                                 0 = serial single-tile path
   RESERVOIR_BENCH_IMPL          auto (default) | xla | pallas   (all three
@@ -79,34 +83,59 @@ HBM_PEAK_BYTES_PER_S = 8.19e11
 _REPO = os.path.dirname(os.path.abspath(__file__))
 
 
-def _algl_bench_geometry(R, k, B):
-    """(block_r, chunk_b, gather_chunk) for the algl bench: the autotune
-    cache entry for this device+shape when one exists (populated by
-    tools/tpu_algl_block_sweep.py), else the hardcoded defaults; explicit
-    env overrides (RESERVOIR_BENCH_BLOCK_R / RESERVOIR_BENCH_CHUNK_B /
-    RESERVOIR_ALGL_CHUNK_B) always win so A/B pseudo-configs stay exact.
-    0 means auto-size for block_r, whole-tile for chunk_b, full-width for
-    gather_chunk."""
+def _bench_geometry(kernel, R, k, B):
+    """(block_r, chunk_b, gather_chunk) for a Pallas bench config: the
+    autotune cache entry for this kernel+device+shape when one exists
+    (populated by tools/tpu_block_sweep.py), else the hardcoded defaults;
+    explicit env overrides (RESERVOIR_BENCH_BLOCK_R /
+    RESERVOIR_BENCH_CHUNK_B / RESERVOIR_ALGL_CHUNK_B) always win so A/B
+    pseudo-configs stay exact.  0 means auto-size for block_r, whole-tile
+    for chunk_b, full-width for gather_chunk (algl only)."""
     from reservoir_tpu.ops import autotune
-    from reservoir_tpu.ops.algorithm_l_pallas import _GATHER_CHUNK_B
 
     geom = None
     try:
         geom = autotune.lookup(
-            jax.devices()[0].device_kind, R, k, B, "int32"
+            jax.devices()[0].device_kind, R, k, B, "int32", kernel=kernel
         )
     except Exception:
         pass
-    block_r = geom.block_r if geom else 64
+    if kernel == "algl":
+        from reservoir_tpu.ops.algorithm_l_pallas import _GATHER_CHUNK_B
+
+        # block 64 is the known-good Mosaic compile for the headline
+        block_r = geom.block_r if geom else 64
+        gather = geom.gather_chunk if geom else _GATHER_CHUNK_B
+    else:
+        block_r = geom.block_r if geom else 0  # 0 = kernel auto-size
+        gather = 0
     chunk_b = geom.chunk_b if geom else 0
-    gather = geom.gather_chunk if geom else _GATHER_CHUNK_B
     if os.environ.get("RESERVOIR_BENCH_BLOCK_R") is not None:
         block_r = int(os.environ["RESERVOIR_BENCH_BLOCK_R"])
     if os.environ.get("RESERVOIR_BENCH_CHUNK_B") is not None:
         chunk_b = int(os.environ["RESERVOIR_BENCH_CHUNK_B"])
-    if os.environ.get("RESERVOIR_ALGL_CHUNK_B") is not None:
+    if kernel == "algl" and os.environ.get("RESERVOIR_ALGL_CHUNK_B") is not None:
         gather = int(os.environ["RESERVOIR_ALGL_CHUNK_B"])
     return block_r, chunk_b, gather
+
+
+def _bytes_per_elem(kernel, k, B, key_bytes=4):
+    """Per-kernel HBM byte model (the roofline the row is judged against,
+    BENCH.md "HBM roofline"): stream bytes per element plus the [R, k]
+    state planes read+written once per tile, amortized over the B elements
+    each reservoir row consumes.
+
+    - algl: 4 B batch read + samples plane r+w       -> 4*(1 + 2k/B)
+    - weighted: 8 B (value + f32 weight) + samples+lkeys planes r+w
+                                                     -> 8*(1 + 2k/B)
+    - distinct: 4 or 8 B by key width + 4 state planes (values, value_hi,
+      hash_hi, hash_lo) r+w                          -> key_bytes + 32k/B
+    """
+    if kernel == "algl":
+        return 4.0 * (1.0 + 2.0 * k / B)
+    if kernel == "weighted":
+        return 8.0 * (1.0 + 2.0 * k / B)
+    return float(key_bytes) + 32.0 * k / B
 
 
 def _probe_backend_proc(timeout_s: float):
@@ -243,7 +272,7 @@ def _bench_algl(R, k, B, steps, reps, impl):
         # block 64 is the known-good Mosaic compile; wider blocks / batch
         # chunks arrive via the autotune cache (sweep winners) or env
         # overrides (RESERVOIR_BENCH_BLOCK_R=0 -> auto)
-        block_r, chunk_b, gather = _algl_bench_geometry(R, k, B)
+        block_r, chunk_b, gather = _bench_geometry("algl", R, k, B)
         step_fn = functools.partial(
             alp.update_steady_pallas,
             block_r=None if block_r == 0 else block_r,
@@ -416,8 +445,11 @@ def _bench_distinct(R, k, B, steps, reps, impl="xla"):
     if impl == "pallas":
         from reservoir_tpu.ops import distinct_pallas as dp
 
+        block_r, chunk_b, _ = _bench_geometry("distinct", R, k, B)
         step_fn = functools.partial(
             dp.update_pallas,
+            block_r=None if block_r == 0 else block_r,
+            chunk_b=None if chunk_b == 0 else chunk_b,
             interpret=jax.default_backend() == "cpu",
         )
     else:
@@ -450,8 +482,11 @@ def _bench_weighted(R, k, B, steps, reps, impl="xla"):
     if impl == "pallas":
         from reservoir_tpu.ops import weighted_pallas as wp
 
+        block_r, chunk_b, _ = _bench_geometry("weighted", R, k, B)
         step_fn = functools.partial(
             wp.update_pallas,
+            block_r=None if block_r == 0 else block_r,
+            chunk_b=None if chunk_b == 0 else chunk_b,
             interpret=jax.default_backend() == "cpu",
         )
     else:
@@ -726,20 +761,20 @@ def main() -> None:
     }
     if config == "bridge":
         record["stages"] = bridge_stages
-    if config == "algl":
-        # HBM roofline (VERDICT r5 weak item 5): per element, one 4-byte
-        # read of the batch plus the [R, k] state read+written once per
-        # tile, amortized over the R*B elements it consumes — so
-        # bytes/elem = 4 * (1 + 2k/B).  hbm_frac is the fraction of a
-        # v5e's ~819 GB/s this run sustained; on non-TPU platforms it is
-        # the same arithmetic against the same constant (context only).
-        bytes_per_elem = 4.0 * (1.0 + 2.0 * k / B)
+    if config in ("algl", "distinct", "weighted"):
+        # HBM roofline (VERDICT r5 weak item 5): per-kernel byte models in
+        # _bytes_per_elem — the stream read per element plus the [R, k]
+        # state planes read+written once per tile, amortized.  hbm_frac is
+        # the fraction of a v5e's ~819 GB/s this run sustained; on non-TPU
+        # platforms it is the same arithmetic against the same constant
+        # (context only).
+        bytes_per_elem = _bytes_per_elem(config, k, B)
         record["bytes_per_elem"] = round(bytes_per_elem, 4)
         record["hbm_frac"] = round(
             value * bytes_per_elem / HBM_PEAK_BYTES_PER_S, 6
         )
         if tag.endswith("_pallas"):
-            block_r, chunk_b, gather = _algl_bench_geometry(R, k, B)
+            block_r, chunk_b, gather = _bench_geometry(config, R, k, B)
             record["geometry"] = {
                 "block_r": block_r,
                 "chunk_b": chunk_b,

@@ -122,6 +122,7 @@ class ReservoirEngine:
         # incoming tile is device_put with the matching sharding, so the
         # cached jitted updates compile to collective-free SPMD programs.
         self._pallas_fallback_logged = False
+        self._tuned_geometry_ignored_logged = False
         self._mesh = None
         self._tile_sharding = None
         self._row_sharding = None
@@ -311,13 +312,20 @@ class ReservoirEngine:
             return f"impl='auto' on backend {jax.default_backend()!r}"
         return None
 
-    def _algl_geometry(self, width: int, tile_dtype):
-        """Tuned ``(block_r, chunk_b, gather_chunk)`` for this tile shape
-        from the persistent autotune cache (:mod:`reservoir_tpu.ops.autotune`),
-        or None — the kernel then uses its hardcoded defaults, so untuned
-        devices (every CPU/interpret run) behave exactly as before."""
-        if self._ops is not _algl:
-            return None
+    def _kernel_name(self) -> str:
+        """The autotune-cache kernel dimension for this engine's mode."""
+        if self._ops is _algl:
+            return "algl"
+        if self._ops is _weighted:
+            return "weighted"
+        return "distinct"
+
+    def _kernel_geometry(self, kernel: str, width: int, tile_dtype):
+        """Tuned ``(block_r, chunk_b, gather_chunk)`` for ``kernel`` at
+        this tile shape from the persistent autotune cache
+        (:mod:`reservoir_tpu.ops.autotune`), or None — the kernel then
+        uses its hardcoded defaults, so untuned devices (every
+        CPU/interpret run) behave exactly as before."""
         from .ops import autotune
 
         try:
@@ -330,33 +338,59 @@ class ReservoirEngine:
             self._config.max_sample_size,
             width,
             tile_dtype,
+            kernel=kernel,
+        )
+
+    def _log_ignored_geometry(
+        self, width: int, tile_dtype, steady: bool, ragged: bool
+    ) -> None:
+        """A tuned cache entry that exists but cannot be used (the tile
+        dispatched XLA) must not be silently skipped — log it once per
+        engine with the dispatch reason, so a mis-shaped production config
+        that defeats its own tuning is visible."""
+        if self._tuned_geometry_ignored_logged:
+            return
+        geometry = self._kernel_geometry(self._kernel_name(), width, tile_dtype)
+        if geometry is None:
+            return
+        self._tuned_geometry_ignored_logged = True
+        import logging
+
+        logging.getLogger(__name__).info(
+            "tuned %s geometry %s for this tile shape is ignored — the "
+            "tile takes the XLA path: %s (logged once per engine)",
+            self._kernel_name(),
+            tuple(geometry),
+            self._pallas_fallback_reason(steady, ragged, tile_dtype),
         )
 
     def _base_update(self, steady: bool, use_pallas: bool, geometry=None):
         """The traceable per-tile update ``(state, tile[, weights][, valid])
         -> state`` for this mode — Pallas kernel (shard_map-wrapped on a
         mesh) or XLA path.  Shared by the per-tile jit cache and the fused
-        stream scan.  ``geometry`` (algl only) is an autotuned
+        stream scan.  ``geometry`` is an autotuned
         :class:`~reservoir_tpu.ops.autotune.Geometry` overriding the
-        kernel's block/chunk defaults."""
+        dispatched kernel's block/chunk defaults (all three kernels take
+        one; ``gather_chunk`` is algl-only)."""
         if use_pallas:
             mod = self._pallas_module()
             if self._ops is _algl:
                 kernel = (
                     mod.update_steady_pallas if steady else mod.update_pallas
                 )
-                if geometry is not None:
-                    kernel = functools.partial(
-                        kernel,
-                        # 0 = "kernel default" for block (auto-size) and
-                        # chunk (whole tile); gather 0 is meaningful
-                        # (full-width) and passes through as-is
-                        block_r=geometry.block_r or None,
-                        chunk_b=geometry.chunk_b or None,
-                        gather_chunk=geometry.gather_chunk,
-                    )
             else:
                 kernel = mod.update_pallas
+            if geometry is not None:
+                # 0 = "kernel default" for block (auto-size) and chunk
+                # (whole tile); gather 0 is meaningful (full-width) and
+                # passes through as-is
+                kwargs = {
+                    "block_r": geometry.block_r or None,
+                    "chunk_b": geometry.chunk_b or None,
+                }
+                if self._ops is _algl:
+                    kwargs["gather_chunk"] = geometry.gather_chunk
+                kernel = functools.partial(kernel, **kwargs)
             base = functools.partial(
                 kernel, interpret=jax.default_backend() == "cpu"
             )
@@ -400,9 +434,13 @@ class ReservoirEngine:
         if fn is None:
             # autotuned geometry is resolved once per jit-cache entry (a
             # stat + dict hit) — the compiled program then carries it
-            geometry = (
-                self._algl_geometry(width, tile_dtype) if use_pallas else None
-            )
+            if use_pallas:
+                geometry = self._kernel_geometry(
+                    self._kernel_name(), width, tile_dtype
+                )
+            else:
+                geometry = None
+                self._log_ignored_geometry(width, tile_dtype, steady, ragged)
             self._geometry_by_key[cache_key] = geometry
             fn = jax.jit(
                 self._base_update(steady, use_pallas, geometry),
@@ -691,9 +729,13 @@ class ReservoirEngine:
                      np.dtype(stream.dtype).str)
         fn = self._jit_cache.get(cache_key)
         if fn is None:
-            geometry = (
-                self._algl_geometry(B, stream.dtype) if use_pallas else None
-            )
+            if use_pallas:
+                geometry = self._kernel_geometry(
+                    self._kernel_name(), B, stream.dtype
+                )
+            else:
+                geometry = None
+                self._log_ignored_geometry(B, stream.dtype, steady, False)
             self._geometry_by_key[cache_key] = geometry
             base = self._base_update(steady, use_pallas, geometry)
             weighted = self._config.weighted

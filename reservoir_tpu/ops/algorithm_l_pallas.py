@@ -59,7 +59,6 @@ from .rng import key_words
 
 __all__ = ["supports", "pick_block_r", "update_pallas", "update_steady_pallas"]
 
-_DEFAULT_BLOCK_R = 64
 # one-hot batch gathers are chunked to this many lanes per instruction:
 # full-width [block_r, B] selects+reduces in the acceptance while_loop are
 # the prime Mosaic compile-time suspect past block 64 (BENCH.md r2: block
@@ -79,11 +78,11 @@ _STREAM_CHUNK_B = int(os.environ.get("RESERVOIR_ALGL_STREAM_CHUNK", "0"))
 
 
 def pick_block_r(num_reservoirs: int, k: int, tile_b: int) -> int:
-    """VMEM-aware row-block (ops.blocking): ~2 k-wide planes (samples
-    in + out) and ~4 B-wide planes (batch + gather temps), 4 bytes each."""
-    from .blocking import pick_block_r as _pick
+    """VMEM-aware row-block from the shared per-kernel byte-budget table
+    (:data:`~reservoir_tpu.ops.blocking.KERNEL_VMEM`)."""
+    from .blocking import kernel_block_r
 
-    return _pick(num_reservoirs, (2 * k + 4 * tile_b) * 4, _DEFAULT_BLOCK_R)
+    return kernel_block_r("algl", num_reservoirs, k, tile_b)
 
 
 def supports(
@@ -327,8 +326,11 @@ def _update_pallas(
         gather_chunk = _GATHER_CHUNK_B
     if chunk_b is None:
         chunk_b = _STREAM_CHUNK_B
-    if chunk_b <= 0 or chunk_b > B or B % chunk_b != 0:
-        chunk_b = B  # whole tile in one grid cell (the compile-proven shape)
+    from .blocking import resolve_chunk
+
+    # invalid chunks run the whole tile in one grid cell (the
+    # compile-proven shape) — never a crash, never a different result
+    chunk_b = resolve_chunk(B, chunk_b)
     R_orig = R
     if R % block_r != 0:
         from .blocking import shrink_block_to

@@ -1,21 +1,27 @@
-"""Persistent block-geometry autotuner for the Algorithm-L Pallas kernel.
+"""Persistent block-geometry autotuner for ALL three Pallas kernels.
 
-The kernel's throughput is set by three shape knobs — ``block_r``
-(reservoir rows per grid cell), ``chunk_b`` (batch-streaming chunk of the
-2-D grid pipeline) and ``gather_chunk`` (lanes per one-hot select+reduce) —
-whose winners are device- and shape-specific and can only be measured on
-live hardware.  Before this module, ``tools/tpu_algl_block_sweep.py``
-measured them into an append-only log nowhere the engine could see; now the
-sweep (and ``tools/tpu_algl_best_block.py``) record winners into a small
-JSON cache keyed by ``(device_kind, R, k, B, dtype)``, and
-``ReservoirEngine._update_fn`` / ``bench.py`` consult it at jit-cache time.
+Every kernel's throughput is set by shape knobs — ``block_r`` (reservoir
+rows per grid cell), ``chunk_b`` (batch-streaming chunk of the 2-D grid
+pipeline) and, for Algorithm-L only, ``gather_chunk`` (lanes per one-hot
+select+reduce) — whose winners are device- and shape-specific and can only
+be measured on live hardware.  The sweep tool
+(``tools/tpu_block_sweep.py``, kernel-parameterized) records winners into
+a small JSON cache keyed by ``(kernel, device_kind, R, k, B, dtype)``, and
+``ReservoirEngine._update_fn`` / ``bench.py`` consult it at jit-cache time
+for whichever kernel the engine dispatches.
 
 Absent a cache entry (every CPU test run, any untuned device/shape) the
 lookup returns ``None`` and callers keep the hardcoded defaults, so
 interpret-mode behavior is byte-identical with or without the file.  The
 cache is *advisory geometry only* — every geometry is bit-identical by
-construction (see :mod:`.algorithm_l_pallas`), so a stale entry can cost
-speed, never correctness.
+construction (see the kernel modules), so a stale entry can cost speed,
+never correctness.
+
+Schema: version 2 prefixes every key with the kernel name and stamps the
+file with ``"_schema": 2``.  Version-1 files (the algl-only era: bare
+``device|R=..|..`` keys, no stamp) are migrated silently on load — each
+bare key is read as an ``algl`` entry — and rewritten in the new schema on
+the first :func:`record`.
 
 File location: ``$RESERVOIR_ALGL_AUTOTUNE_CACHE`` if set, else
 ``TPU_ALGL_AUTOTUNE.json`` at the repo root (committed with the sweep
@@ -35,6 +41,7 @@ import numpy as np
 
 __all__ = [
     "Geometry",
+    "KERNELS",
     "cache_path",
     "make_key",
     "load",
@@ -48,6 +55,10 @@ _REPO = os.path.dirname(
 )
 _DEFAULT_CACHE = os.path.join(_REPO, "TPU_ALGL_AUTOTUNE.json")
 
+_SCHEMA = 2
+#: The kernel dimension of the cache key — one entry space per Pallas path.
+KERNELS = ("algl", "weighted", "distinct")
+
 # (path, mtime) -> parsed dict; loads are hot (one per engine jit-cache
 # miss), files are tiny and almost never change mid-process
 _LOAD_MEMO: dict = {}
@@ -58,7 +69,8 @@ class Geometry(NamedTuple):
 
     ``block_r``: rows per grid cell (0 = kernel auto-size).
     ``chunk_b``: batch-streaming chunk (0 = whole tile, no 2-D grid).
-    ``gather_chunk``: one-hot gather window (0 = full width).
+    ``gather_chunk``: one-hot gather window (0 = full width; algl only —
+    the weighted/distinct kernels ignore it).
     """
 
     block_r: int
@@ -70,14 +82,39 @@ def cache_path() -> str:
     return os.environ.get("RESERVOIR_ALGL_AUTOTUNE_CACHE", _DEFAULT_CACHE)
 
 
-def make_key(device_kind: str, R: int, k: int, B: int, dtype) -> str:
-    """Stable cache key: the geometry winner depends on all five."""
-    return f"{device_kind}|R={R}|k={k}|B={B}|{np.dtype(dtype).name}"
+def make_key(
+    device_kind: str, R: int, k: int, B: int, dtype, *, kernel: str = "algl"
+) -> str:
+    """Stable cache key: the geometry winner depends on all six."""
+    return (
+        f"{kernel}|{device_kind}|R={R}|k={k}|B={B}|{np.dtype(dtype).name}"
+    )
+
+
+def _migrate(data: dict) -> dict:
+    """Entries in schema-2 key form, whatever schema the file was.
+
+    A v1 file has no ``"_schema"`` stamp and bare (kernel-less) keys —
+    every such key was written by the algl-only sweep era, so it maps to
+    ``algl|<key>``.  The stamp key itself never reaches callers."""
+    if data.get("_schema") == _SCHEMA:
+        return {key: v for key, v in data.items() if key != "_schema"}
+    out = {}
+    for key, v in data.items():
+        if key == "_schema" or not isinstance(key, str):
+            continue
+        if key.split("|", 1)[0] in KERNELS:
+            out[key] = v
+        else:
+            out["algl|" + key] = v
+    return out
 
 
 def load(path: "str | None" = None) -> dict:
-    """The parsed cache file ({} when absent or unparseable — a corrupt
-    cache must degrade to defaults, never break sampling)."""
+    """The parsed cache entries keyed in schema-2 form ({} when absent or
+    unparseable — a corrupt cache must degrade to defaults, never break
+    sampling).  Version-1 files are migrated in memory here; the first
+    :func:`record` persists the migration."""
     path = path or cache_path()
     try:
         mtime = os.stat(path).st_mtime_ns
@@ -93,6 +130,7 @@ def load(path: "str | None" = None) -> dict:
             data = {}
     except (OSError, json.JSONDecodeError):
         data = {}
+    data = _migrate(data)
     _LOAD_MEMO[path] = (mtime, data)
     return data
 
@@ -104,9 +142,14 @@ def lookup(
     B: int,
     dtype,
     path: "str | None" = None,
+    *,
+    kernel: str = "algl",
 ) -> Optional[Geometry]:
-    """The tuned geometry for this device+shape, or None (use defaults)."""
-    entry = load(path).get(make_key(device_kind, R, k, B, dtype))
+    """The tuned geometry for this kernel+device+shape, or None (use the
+    kernel's hardcoded defaults)."""
+    entry = load(path).get(
+        make_key(device_kind, R, k, B, dtype, kernel=kernel)
+    )
     if not isinstance(entry, dict):
         return None
     try:
@@ -129,9 +172,12 @@ def record(
     elem_per_sec: "float | None" = None,
     source: "str | None" = None,
     path: "str | None" = None,
+    *,
+    kernel: str = "algl",
 ) -> None:
     """Write one geometry entry (atomic tmp+rename; merges with the
-    existing file).  ``elem_per_sec``/``source`` ride along as provenance —
+    existing file, migrating a v1 file to schema 2 as it does).
+    ``elem_per_sec``/``source`` ride along as provenance —
     :func:`record_if_better` uses the rate to keep only winners."""
     path = path or cache_path()
     data = dict(load(path))
@@ -144,7 +190,8 @@ def record(
         entry["elem_per_sec"] = float(elem_per_sec)
     if source is not None:
         entry["source"] = source
-    data[make_key(device_kind, R, k, B, dtype)] = entry
+    data[make_key(device_kind, R, k, B, dtype, kernel=kernel)] = entry
+    data["_schema"] = _SCHEMA
     d = os.path.dirname(os.path.abspath(path)) or "."
     fd, tmp = tempfile.mkstemp(prefix=".autotune.", dir=d)
     try:
@@ -171,17 +218,21 @@ def record_if_better(
     elem_per_sec: float,
     source: "str | None" = None,
     path: "str | None" = None,
+    *,
+    kernel: str = "algl",
 ) -> bool:
     """Record only if no entry exists or this rate beats the stored one
     (sweep callers: every variant reports through here, winners stick).
     Returns whether the entry was written."""
-    entry = load(path).get(make_key(device_kind, R, k, B, dtype))
+    entry = load(path).get(
+        make_key(device_kind, R, k, B, dtype, kernel=kernel)
+    )
     if isinstance(entry, dict):
         prev = entry.get("elem_per_sec")
         if isinstance(prev, (int, float)) and prev >= elem_per_sec:
             return False
     record(
         device_kind, R, k, B, dtype, geometry,
-        elem_per_sec=elem_per_sec, source=source, path=path,
+        elem_per_sec=elem_per_sec, source=source, path=path, kernel=kernel,
     )
     return True

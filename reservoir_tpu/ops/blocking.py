@@ -1,18 +1,34 @@
-"""Row-block sizing shared by the Pallas kernels.
+"""Chunked-grid geometry scaffolding shared by all three Pallas kernels.
+
+Every kernel in this package runs the same 2-D grid architecture: a
+``[block_r]`` row-block of reservoirs stays VMEM-resident while the batch
+(and weights, for A-ExpJ) streams through in ``chunk_b``-wide chunks that
+Mosaic's grid pipeline double-buffers.  This module owns the two geometry
+decisions the kernels share:
+
+- :func:`pick_block_r` / :func:`kernel_block_r` — VMEM-aware row-block
+  sizing from a per-kernel bytes-per-row model (:data:`KERNEL_VMEM`);
+- :func:`resolve_chunk` — batch-chunk validation (invalid chunks silently
+  fall back to the whole-tile single-chunk grid, never to an error or a
+  different result).
 
 Mosaic grid cells run sequentially on the TensorCore, so per-cell overhead
 is amortized by wider reservoir row-blocks — but each cell's working set
-(state block + batch block + elementwise temps) must fit VMEM.  Measured on
-v5e (BENCH.md sweep, 2026-07-30): the distinct config gains 2.1x going from
-block 8 to 128; the weighted config gains 1.2x from 64 to 128 and fails to
-allocate at 256.  ``pick_block_r`` returns the largest power-of-2 divisor
-of R that stays under both the measured cap (128) and a per-kernel VMEM
-budget, from a caller-supplied bytes-per-row estimate.
+(state block + batch chunk + elementwise temps) must fit VMEM.  The
+measured row-block sweep behind the cap and the per-kernel minimums lives
+in BENCH.md ("Row-block sizing").
 """
 
 from __future__ import annotations
 
-__all__ = ["pick_block_r", "pad_rows", "shrink_block_to"]
+__all__ = [
+    "KERNEL_VMEM",
+    "kernel_block_r",
+    "pick_block_r",
+    "pad_rows",
+    "resolve_chunk",
+    "shrink_block_to",
+]
 
 
 def shrink_block_to(num_reservoirs: int, block_r: int) -> int:
@@ -35,11 +51,55 @@ def pad_rows(pad: int, *arrays):
         jnp.concatenate([a, jnp.repeat(a[-1:], pad, axis=0)]) for a in arrays
     )
 
+
+def resolve_chunk(
+    tile_b: int, chunk_b: "int | None", multiple_of: int = 1
+) -> int:
+    """The batch-streaming chunk the grid actually runs: ``chunk_b`` when it
+    is a proper divisor of the tile width (and a multiple of
+    ``multiple_of`` — the weighted kernel's cumsum-association constraint,
+    :data:`~reservoir_tpu.ops.prefix.CUMSUM_BLOCK`), else the whole tile in
+    one grid cell.  An invalid chunk must cost speed, never a crash or a
+    different result."""
+    if not chunk_b or chunk_b <= 0 or chunk_b >= tile_b:
+        return tile_b
+    if tile_b % chunk_b != 0 or chunk_b % multiple_of != 0:
+        return tile_b
+    return chunk_b
+
+
 _MAX_BLOCK_R = 128
 # half of v5e's ~16 MiB VMEM, leaving the rest for Mosaic's own temporaries
 # and double-buffering; block 256 at the weighted bench shape (~8.4 MB by
 # its estimate) is the measured allocation failure this budget excludes
 _VMEM_BUDGET_BYTES = 6 * 1024 * 1024
+
+#: Per-kernel VMEM models: ``(row_bytes(k, chunk_b), min_block)``.
+#: ``row_bytes`` estimates the VMEM bytes one reservoir row keeps live in a
+#: grid cell (k-wide state planes in + out, chunk-wide batch planes and
+#: elementwise temps, 4 bytes each); ``min_block`` is the smallest row-block
+#: the kernel's grid was ever measured/gated at — auto-sizing only ever
+#: widens from it.
+KERNEL_VMEM = {
+    # algl: ~2 k-wide planes (samples in + out) + ~4 chunk-wide planes
+    # (batch + gather temps)
+    "algl": (lambda k, chunk_b: (2 * k + 4 * chunk_b) * 4, 64),
+    # weighted: ~4 k-wide planes (samples + lkeys, in + out) + ~8
+    # chunk-wide planes (elems, weights, cumsum, rank, RNG words, masks)
+    "weighted": (lambda k, chunk_b: (4 * k + 8 * chunk_b) * 4, 64),
+    # distinct: ~9 k-wide planes (4 state planes in + 5 out) + ~8
+    # chunk-wide planes (2 value planes + scrambled hashes + masks)
+    "distinct": (lambda k, chunk_b: (9 * k + 8 * chunk_b) * 4, 8),
+}
+
+
+def kernel_block_r(
+    kernel: str, num_reservoirs: int, k: int, chunk_b: int
+) -> int:
+    """VMEM-aware row-block for ``kernel`` at this ``(k, chunk_b)`` cell
+    shape — the one sizing rule all three kernels share."""
+    row_bytes_fn, min_block = KERNEL_VMEM[kernel]
+    return pick_block_r(num_reservoirs, row_bytes_fn(k, chunk_b), min_block)
 
 
 def pick_block_r(num_reservoirs: int, row_bytes: int, min_block: int) -> int:

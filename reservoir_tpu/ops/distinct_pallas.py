@@ -17,6 +17,18 @@ in VMEM and does per tile:
   tile lane carrying the same (hash, value) — so within-tile duplicates
   cost one iteration total, not one each.
 
+Grid-pipelined batch streaming (the r7 roofline restructure, mirroring the
+Algorithm-L kernel's 2-D grid): the grid is ``(row-block, batch-chunk)``.
+The sorted bottom-k block stays VMEM-resident across the whole batch axis
+while the value planes stream HBM→VMEM one ``[block_r, chunk_b]`` chunk at
+a time, double-buffered by Mosaic's grid pipeline against the previous
+chunk's scramble + threshold compare.  State equality across every chunk
+decomposition is by construction: the maintained bottom-k-of-distinct
+summary is an order-insensitive pure function of the value set seen, so
+feeding the tile chunk-by-chunk reaches exactly the sort-merge result —
+the threshold compare and dedup loop operate per distinct below-threshold
+value with no cross-chunk arithmetic to re-associate.
+
 State equality with the XLA sort-merge path is exact: both maintain the
 same canonical representation (entries sorted by (hash, value-bits)
 ascending, (MAX, MAX)/0 padding, explicit size), and insertion position
@@ -25,8 +37,9 @@ identically.  Sole caveat (shared with the native host scan): a value
 whose scrambled hash is exactly (MAX, MAX) is never accepted by the
 strict threshold compare, where the XLA path's pad-flag would keep it —
 probability 2^-64, the documented bias class.  Pinned by
-``tests/test_pallas_distinct.py`` in interpret mode and by the engine
-dispatch equivalence tests.
+``tests/test_pallas_distinct.py`` in interpret mode (including chunk
+boundaries splitting duplicate runs) and by the engine dispatch
+equivalence tests.
 
 Scope (engine dispatch via :func:`supports`): full tiles, identity
 ``map_fn``/default hash, int32 counters, narrow (4-byte) or wide (8-byte
@@ -51,20 +64,13 @@ from .prefix import lane_cumsum
 
 __all__ = ["supports", "update_pallas", "pick_block_r"]
 
-# minimum row-block the grid requires (engine eligibility gate); the actual
-# block defaults to pick_block_r — wider blocks amortize per-grid-cell
-# overhead (512 sequential cells at block 8 for R=4096 measured 7.3e8
-# elem/s on v5e; 32 cells at block 128 measured 1.54e9, 2026-07-30)
-_DEFAULT_BLOCK_R = 8
-
 
 def pick_block_r(num_reservoirs: int, k: int, tile_b: int) -> int:
-    """VMEM-aware row-block (ops.blocking): ~9 k-wide planes (4 state
-    planes in + 5 out) and ~8 B-wide planes (2 value planes + scrambled
-    hashes + candidate/temp masks), 4 bytes each."""
-    from .blocking import pick_block_r as _pick
+    """VMEM-aware row-block from the shared per-kernel byte-budget table
+    (:data:`~reservoir_tpu.ops.blocking.KERNEL_VMEM`)."""
+    from .blocking import kernel_block_r
 
-    return _pick(num_reservoirs, (9 * k + 8 * tile_b) * 4, _DEFAULT_BLOCK_R)
+    return kernel_block_r("distinct", num_reservoirs, k, tile_b)
 
 
 def supports(
@@ -136,14 +142,30 @@ def _kernel(
     out_size_ref,
     *,
     k: int,
-    block_b: int,
 ):
-    """One grid cell = one ``[block_r]`` row-block of reservoirs × one tile."""
+    """One grid cell = one ``[block_r]`` row-block × one ``[chunk_b]``
+    batch chunk.
+
+    The resident bottom-k blocks (``out_*``, including ``out_size``) are
+    VMEM-resident across the whole chunk axis — their index maps ignore
+    the chunk dimension, so chunk ``j`` reads the carry chunk ``j-1`` left
+    behind and only the last chunk's result is written back to HBM.
+    Chunk 0 seeds the carry from the inputs behind a ``pl.when``.
+    """
     block_r = size_ref.shape[0]
-    del block_b  # tile width is implicit in the refs' second axis
+    j = pl.program_id(1)
     lane_k = jax.lax.broadcasted_iota(jnp.int32, (block_r, k), 1)
 
-    # scramble the tile's (hi, lo) value planes under the per-lane salts
+    # chunk 0 seeds the VMEM-resident carry; later chunks mutate in place.
+    @pl.when(j == 0)
+    def _seed_carry():
+        out_values_ref[:, :] = values_ref[:, :]
+        out_vhi_ref[:, :] = vhi_ref[:, :]
+        out_hhi_ref[:, :] = hhi_ref[:, :]
+        out_hlo_ref[:, :] = hlo_ref[:, :]
+        out_size_ref[:, :] = size_ref[:, :]
+
+    # scramble the chunk's (hi, lo) value planes under the per-lane salts
     bvhi = bvhi_ref[:, :]
     bvlo = bvlo_ref[:, :]
     bhhi, bhlo = scramble64(
@@ -155,11 +177,6 @@ def _kernel(
         salts_ref[:, 3:4],
     )
 
-    out_values_ref[:, :] = values_ref[:, :]
-    out_vhi_ref[:, :] = vhi_ref[:, :]
-    out_hhi_ref[:, :] = hhi_ref[:, :]
-    out_hlo_ref[:, :] = hlo_ref[:, :]
-
     # candidates: below the running threshold = the max retained hash when
     # full, (MAX, MAX) otherwise — i.e. simply the last entry of the sorted
     # block (padding IS (MAX, MAX))
@@ -170,7 +187,7 @@ def _kernel(
         return thi, tlo
 
     thi, tlo = threshold()
-    cand = _lex_lt(bhhi, bhlo, thi, tlo)  # [r, B]
+    cand = _lex_lt(bhhi, bhlo, thi, tlo)  # [r, chunk]
 
     # the while_loop carries the candidate mask as int32, not bool: Mosaic
     # cannot yield i1 vectors from scf loops (failed-to-legalize on TPU,
@@ -188,7 +205,7 @@ def _kernel(
         is_mhi = cand_c & (bhhi == mhi)
         mlo = _umin_where(is_mhi, bhlo)
         hit = is_mhi & (bhlo == mlo)
-        # first tile lane carrying (mhi, mlo): its value bits
+        # first chunk lane carrying (mhi, mlo): its value bits
         first = hit & (lane_cumsum(hit.astype(jnp.int32)) == 1)
         vlo = _usel(first, bvlo_ref[:, :])
         vhi = _usel(first, bvhi_ref[:, :])
@@ -241,8 +258,9 @@ def _kernel(
         size_n = jnp.where(
             do_insert, jnp.minimum(size_c + 1, k), size_c
         )
-        # retire every tile lane carrying this (hash, value) — within-tile
-        # duplicates cost one iteration total
+        # retire every chunk lane carrying this (hash, value) — within-
+        # chunk duplicates cost one iteration total (cross-chunk repeats
+        # fail the tightened threshold or the dedup compare instead)
         consumed = (
             (bhhi == mhi) & (bhlo == mlo)
             & (bvhi_ref[:, :] == vhi) & (bvlo_ref[:, :] == vlo)
@@ -254,7 +272,7 @@ def _kernel(
         return cand_n.astype(jnp.int32), size_n
 
     _, size = jax.lax.while_loop(
-        cond, body, (cand.astype(jnp.int32), size_ref[:, :])
+        cond, body, (cand.astype(jnp.int32), out_size_ref[:, :])
     )
     out_size_ref[:, :] = size
 
@@ -264,13 +282,17 @@ def update_pallas(
     batch,
     *,
     block_r=None,
+    chunk_b: "int | None" = None,
     interpret: bool = False,
 ) -> DistinctState:
     """Full-tile distinct merge, state-identical to
     :func:`reservoir_tpu.ops.distinct.update` on full tiles (default hash).
 
     ``batch`` is ``[R, B]`` (narrow) or an ``(hi, lo)`` uint32 plane pair
-    (wide).  Requires :func:`supports`.
+    (wide).  Requires :func:`supports`.  ``chunk_b`` streams the batch
+    through the 2-D grid pipeline in ``B // chunk_b`` chunks (``None``/0
+    or a non-divisor of B = whole tile in one cell); every decomposition
+    is state-identical by construction.
     """
     R, k = state.values.shape
     wide = state.wide
@@ -296,8 +318,11 @@ def update_pallas(
         cvhi = _carried_hi(state.values)
         cvalues = state.values
     B = bvlo.shape[1]
+    from .blocking import resolve_chunk
+
+    chunk_b = resolve_chunk(B, chunk_b)
     if block_r is None:
-        block_r = pick_block_r(R, k, B)
+        block_r = pick_block_r(R, k, chunk_b)
     if bvlo.shape[0] != R:
         raise ValueError(f"batch has {bvlo.shape[0]} rows for {R} reservoirs")
     hash_hi, hash_lo = state.hash_hi, state.hash_lo
@@ -319,14 +344,20 @@ def update_pallas(
             )
             R += pad
 
-    col = lambda i: (i, 0)  # noqa: E731 — row-block i, full second axis
+    col = lambda i, j: (i, 0)  # noqa: E731 — row-block i, chunk-invariant
     col_spec = lambda w: pl.BlockSpec(  # noqa: E731
         (block_r, w), col, memory_space=pltpu.VMEM
     )
+    # the streamed value planes: chunk j of row-block i — the only blocks
+    # whose index varies along the inner grid axis, so Mosaic's pipeline
+    # double-buffers exactly these HBM->VMEM streams
+    stream_spec = pl.BlockSpec(
+        (block_r, chunk_b), lambda i, j: (i, j), memory_space=pltpu.VMEM
+    )
 
     out_values, out_vhi, out_hhi, out_hlo, out_size = pl.pallas_call(
-        functools.partial(_kernel, k=k, block_b=B),
-        grid=(R // block_r,),
+        functools.partial(_kernel, k=k),
+        grid=(R // block_r, B // chunk_b),
         in_specs=[
             col_spec(k),
             col_spec(k),
@@ -334,8 +365,8 @@ def update_pallas(
             col_spec(k),
             col_spec(1),
             col_spec(4),
-            col_spec(B),
-            col_spec(B),
+            stream_spec,
+            stream_spec,
         ],
         out_specs=(
             col_spec(k),

@@ -2,14 +2,25 @@
 
 Mosaic has no lowering for the ``cumsum`` primitive (NotImplementedError on
 TPU, observed 2026-07-30), so Pallas kernels cannot call ``jnp.cumsum``.
-This log-step shifted-add scan (Hillis-Steele) lowers everywhere.  For float
-inputs the summation *association* determines the rounded partial sums, so
-any path that must stay bit-identical to a Pallas kernel (the weighted
-A-ExpJ weight cumsum — ``ops.weighted`` vs ``ops.weighted_pallas``) uses
-this same helper rather than ``jnp.cumsum``: identical decomposition ==
-identical floats, on every backend.  Integer scans are exact under any
-association; Pallas kernels still use this helper for them (no cumsum
-primitive), while XLA-only integer scans keep ``jnp.cumsum``.
+This scan lowers everywhere.  For float inputs the summation *association*
+determines the rounded partial sums, so any path that must stay bit-identical
+to a Pallas kernel (the weighted A-ExpJ weight cumsum — ``ops.weighted`` vs
+``ops.weighted_pallas``) uses this same helper rather than ``jnp.cumsum``:
+identical decomposition == identical floats, on every backend.  Integer
+scans are exact under any association; Pallas kernels still use this helper
+for them (no cumsum primitive), while XLA-only integer scans keep
+``jnp.cumsum``.
+
+The association is **blocked** so the grid-pipelined kernels can stream a
+tile through VMEM in chunks without changing a single partial-sum bit:
+the axis is split into fixed ``_CUMSUM_BLOCK``-lane blocks, each block is
+scanned with the log-step shifted-add (Hillis-Steele) form, and a scalar
+carry — the running inclusive sum at each block's last lane — is folded
+across blocks *sequentially*.  A kernel that consumes the axis in chunks
+that are multiples of ``_CUMSUM_BLOCK`` reproduces the exact same float
+adds in the exact same order by carrying that scalar across grid cells
+(:func:`lane_cumsum_carry`), so the full-tile XLA path and every chunked
+grid decomposition agree bit-for-bit by construction.
 """
 
 from __future__ import annotations
@@ -17,12 +28,18 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-__all__ = ["lane_cumsum"]
+__all__ = ["lane_cumsum", "lane_cumsum_carry", "CUMSUM_BLOCK"]
+
+# The fixed block of the shared association — one TPU vreg lane row.  This
+# is an ALGORITHMIC constant, not a tuning knob: both the XLA paths and
+# every kernel chunk geometry must agree on it, and chunked kernels only
+# accept batch chunks that are multiples of it (ops.blocking.resolve_chunk).
+CUMSUM_BLOCK = 128
+_CUMSUM_BLOCK = CUMSUM_BLOCK
 
 
-def lane_cumsum(x: jax.Array, axis: int = -1) -> jax.Array:
+def _hillis(x: jax.Array, axis: int) -> jax.Array:
     """Inclusive prefix sum along ``axis`` via log2(n) shifted adds."""
-    axis = axis % x.ndim
     n = x.shape[axis]
     d = 1
     while d < n:
@@ -31,3 +48,37 @@ def lane_cumsum(x: jax.Array, axis: int = -1) -> jax.Array:
         x = x + jnp.concatenate([zeros, kept], axis=axis)
         d *= 2
     return x
+
+
+def lane_cumsum_carry(
+    x: jax.Array, carry: "jax.Array | None", axis: int = -1
+) -> "tuple[jax.Array, jax.Array]":
+    """Inclusive blocked prefix sum with an explicit scalar carry.
+
+    Returns ``(cw, carry_out)``: ``cw[..., p] = carry + x[..., :p+1]`` under
+    the blocked association above, and ``carry_out`` is ``cw``'s last lane —
+    the value to feed the next chunk so the concatenation of per-chunk scans
+    is bit-identical to one scan over the concatenated axis (chunk widths
+    must be multiples of ``CUMSUM_BLOCK``).  ``carry=None`` starts a fresh
+    scan; chunked kernels seed their carry ref with literal ``0.0`` instead,
+    and the single ``+ 0.0`` per block is the identity for every partial
+    sum a nonnegative-weight scan can produce.
+    """
+    axis = axis % x.ndim
+    n = x.shape[axis]
+    parts = []
+    for off in range(0, n, _CUMSUM_BLOCK):
+        w = min(_CUMSUM_BLOCK, n - off)
+        h = _hillis(jax.lax.slice_in_dim(x, off, off + w, axis=axis), axis)
+        if carry is not None:
+            h = h + carry
+        parts.append(h)
+        carry = jax.lax.slice_in_dim(h, w - 1, w, axis=axis)
+    out = parts[0] if len(parts) == 1 else jnp.concatenate(parts, axis=axis)
+    return out, carry
+
+
+def lane_cumsum(x: jax.Array, axis: int = -1) -> jax.Array:
+    """Inclusive prefix sum along ``axis`` (the shared blocked association)."""
+    out, _ = lane_cumsum_carry(x, None, axis=axis)
+    return out

@@ -6,18 +6,34 @@ XLA vmap path carries ``samples [R, k]`` + ``lkeys [R, k]`` through a batched
 HBM traffic) per acceptance round.  Here the reservoir block lives in VMEM
 for the whole tile and acceptances mutate it in place.
 
+Grid-pipelined batch streaming (the r7 roofline restructure, mirroring the
+Algorithm-L kernel's 2-D grid): the grid is ``(row-block, batch-chunk)``.
+The ``[block_r, k]`` samples+lkeys blocks and the scalar carries stay
+VMEM-resident across the whole batch axis, while the element and weight
+tiles stream HBM→VMEM one ``[block_r, chunk_b]`` chunk at a time — Mosaic
+double-buffers that input stream against the previous chunk's acceptance
+loop.  Bit-equivalence with the XLA path across every chunk decomposition
+is by construction, not by luck: draws are counter-keyed Threefry channels
+at *absolute* stream indices, and the weight prefix sum uses the shared
+blocked association of :mod:`.prefix` — each chunk continues the scan from
+a carried scalar, reproducing the full-tile partial sums bit-for-bit as
+long as ``chunk_b`` is a multiple of ``prefix.CUMSUM_BLOCK``
+(:func:`~reservoir_tpu.ops.blocking.resolve_chunk` falls back to the
+single-chunk grid otherwise).  The acceptance ``while_loop`` carries
+``(xw, base)`` across chunks in the tile-global frame; the end-of-tile
+``xw`` rebase happens only in the last chunk.
+
 Unlike the Algorithm-L kernel this one is **fill-capable**: weighted fill
 cannot be proven over from a host-side element count (zero-weight items
 advance ``count`` without taking a slot — the zero-weight contract of
 :mod:`.weighted`), so the engine can never dispatch a steady-only weighted
-kernel safely.  The fill scatter is a k-step in-VMEM loop instead.
+kernel safely.  The fill scatter is a k-step in-VMEM loop instead, run per
+chunk only while some reservoir in the row-block still has empty slots.
 
 Bit-equivalence with :func:`reservoir_tpu.ops.weighted.update` on full tiles
-is by construction: both paths consume the same counter-keyed Threefry
-channels (``rng.uniforms(key, idx, (3,))`` — fill key, conditional key, jump
-draw) at the same absolute indices, and every float op (cumsum partial sums,
-``log``/``exp`` chain, f32-min clamps) is the same trace.  Pinned in
-interpret mode by ``tests/test_pallas_weighted.py``.
+is pinned in interpret mode by ``tests/test_pallas_weighted.py`` (including
+chunk boundaries splitting acceptance chains and zero-weight runs) and on
+hardware by ``tests/test_pallas_device.py``.
 
 Scope (engine dispatch via :func:`supports`): full tiles (no ``valid``),
 identity ``map_fn``, int32 counters, int32/float32/uint32 samples, float32
@@ -34,28 +50,22 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from .prefix import lane_cumsum
+from .prefix import CUMSUM_BLOCK, lane_cumsum, lane_cumsum_carry
 from .rng import key_words, uniform_from_bits
 from .threefry import counter_bits
 from .weighted import WeightedState, _NEG_INF, _draw_xw
 
 __all__ = ["supports", "update_pallas", "pick_block_r"]
 
-# minimum row-block the grid requires (engine eligibility gate); the actual
-# block defaults to pick_block_r — at R=16,384 k=64 B=1024 on v5e, block 64
-# measured 3.18e9 elem/s and block 128 measured 3.85e9 (256 fails VMEM),
-# 2026-07-30
-_DEFAULT_BLOCK_R = 64
 _F32_MIN = float(jnp.finfo(jnp.float32).min)
 
 
 def pick_block_r(num_reservoirs: int, k: int, tile_b: int) -> int:
-    """VMEM-aware row-block (ops.blocking): ~4 k-wide planes (samples +
-    lkeys, in + out) and ~8 B-wide planes (elems, weights, cumsum, rank,
-    RNG words and masks), 4 bytes each."""
-    from .blocking import pick_block_r as _pick
+    """VMEM-aware row-block from the shared per-kernel byte-budget table
+    (:data:`~reservoir_tpu.ops.blocking.KERNEL_VMEM`)."""
+    from .blocking import kernel_block_r
 
-    return _pick(num_reservoirs, (4 * k + 8 * tile_b) * 4, _DEFAULT_BLOCK_R)
+    return kernel_block_r("weighted", num_reservoirs, k, tile_b)
 
 
 def supports(
@@ -93,34 +103,67 @@ def _kernel(
     out_samples_ref,
     out_lkeys_ref,
     out_xw_ref,
+    base_ref,
+    cwsum_ref,
     *,
     k: int,
-    block_b: int,
+    chunk_b: int,
+    n_chunks: int,
 ):
-    """One grid cell = one ``[block_r]`` row-block of reservoirs × one tile.
+    """One grid cell = one ``[block_r]`` row-block × one ``[chunk_b]``
+    batch chunk.
 
     Mirrors ``weighted._update_one`` (fill=True, full tile) exactly, with
     per-reservoir scalars as ``[block_r, 1]`` columns and the membership
-    scatter/gathers as one-hot masked reductions.
+    scatter/gathers as one-hot masked reductions.  The state blocks and
+    the three scalar carries are VMEM-resident across the chunk axis
+    (their index maps ignore the chunk dimension); chunk 0 seeds them
+    behind a ``pl.when``:
+
+    - ``out_xw_ref``: the un-rebased jump accumulator — the XLA
+      ``while_loop``'s ``xw`` carry.  The tile-end rebase
+      ``xw -= total_w - base`` runs only in the last chunk.
+    - ``base_ref``: the prefix-weight base of the last acceptance, in the
+      TILE-global frame (chunk 0 seeds 0.0, matching the XLA ``base0``).
+    - ``cwsum_ref``: the blocked prefix-sum fold carry
+      (:func:`~reservoir_tpu.ops.prefix.lane_cumsum_carry`), so each
+      chunk's ``cw`` values are the tile-global partial sums bit-for-bit.
     """
     count = count_ref[:, :]  # [r, 1] int32 (pre-tile count)
+    j = pl.program_id(1)
+    base_off = j * jnp.int32(chunk_b)  # this chunk's offset in the tile
     k1 = key_ref[:, 0:1]
     k2 = key_ref[:, 1:2]
     block_r = count.shape[0]
 
-    lane_b = jax.lax.broadcasted_iota(jnp.int32, (block_r, block_b), 1)
+    lane_b = jax.lax.broadcasted_iota(jnp.int32, (block_r, chunk_b), 1)
     lane_k = jax.lax.broadcasted_iota(jnp.int32, (block_r, k), 1)
 
-    wf = weights_ref[:, :]  # [r, B] f32
+    # chunk 0 seeds the VMEM-resident carries; later chunks mutate in place
+    @pl.when(j == 0)
+    def _seed_carry():
+        out_samples_ref[:, :] = samples_ref[:, :]
+        out_lkeys_ref[:, :] = lkeys_ref[:, :]
+        out_xw_ref[:, :] = xw_ref[:, :]
+        base_ref[:, :] = jnp.zeros((block_r, 1), jnp.float32)
+        cwsum_ref[:, :] = jnp.zeros((block_r, 1), jnp.float32)
+
+    wf = weights_ref[:, :]  # [r, chunk] f32
     positive = wf > 0.0
-    cw = lane_cumsum(wf)  # [r, B]; same association as the XLA path
-    total_w = cw[:, block_b - 1 : block_b]  # [r, 1]
+    # tile-global partial sums: the carried scalar continues the blocked
+    # fold exactly where the previous chunk left it (the + 0.0 of chunk
+    # 0's first block is the identity for nonnegative-weight sums)
+    cw, cw_carry = lane_cumsum_carry(wf, cwsum_ref[:, :])
+    cwsum_ref[:, :] = cw_carry
+    total_w = cw[:, chunk_b - 1 : chunk_b]  # [r, 1] global through chunk j
     n_filled = jnp.sum(
-        (lkeys_ref[:, :] > _NEG_INF).astype(jnp.int32), axis=1, keepdims=True
+        (out_lkeys_ref[:, :] > _NEG_INF).astype(jnp.int32),
+        axis=1,
+        keepdims=True,
     )
-    need = jnp.maximum(k - n_filled, 0)  # [r, 1]
-    prank = lane_cumsum(positive.astype(jnp.int32))  # [r, B]
-    idx_abs = count + lane_b + 1  # [r, B] absolute 1-based
+    need = jnp.maximum(k - n_filled, 0)  # [r, 1] slots still empty
+    prank = lane_cumsum(positive.astype(jnp.int32))  # [r, chunk] 1-based
+    idx_abs = count + base_off + lane_b + 1  # [r, chunk] absolute 1-based
 
     # ---- fill phase (positive items take the next free slots in order) ----
     w0_fill, _, _ = counter_bits(k1, k2, idx_abs, 3)
@@ -134,13 +177,11 @@ def _kernel(
     fill_mask = positive & (prank <= need)
     dest = jnp.where(fill_mask, n_filled + prank - 1, k)  # k -> dropped
 
-    out_samples_ref[:, :] = samples_ref[:, :]
-    out_lkeys_ref[:, :] = lkeys_ref[:, :]
     elem_bits_all = jax.lax.bitcast_convert_type(elems_ref[:, :], jnp.int32)
     lk_bits_all = jax.lax.bitcast_convert_type(lk_fill, jnp.int32)
 
     def fill_slot(s, _):
-        col = dest == s  # [r, B]; at most one lane per row
+        col = dest == s  # [r, chunk]; at most one lane per row
         wrote = jnp.any(col, axis=1, keepdims=True)  # [r, 1]
         e_bits = _row_gather_bits(col, elem_bits_all)
         l_bits = _row_gather_bits(col, lk_bits_all)
@@ -166,25 +207,31 @@ def _kernel(
     def _run_fill():
         jax.lax.fori_loop(0, k, fill_slot, 0)
 
-    # fill completing inside this tile draws the first jump, keyed on index k
-    n_pos = prank[:, block_b - 1 : block_b]
+    # fill completing inside this chunk draws the first jump, keyed on
+    # index k (the same constant-keyed draw the XLA path makes in the tile
+    # where its fill completes)
+    n_pos = prank[:, chunk_b - 1 : chunk_b]
     completes = (n_filled < k) & (n_filled + n_pos >= k)
     _, _, w2_init = counter_bits(
         k1, k2, jnp.full_like(count, k), 3
     )
     u3_init = uniform_from_bits(w2_init)
     min_lk = jnp.min(out_lkeys_ref[:, :], axis=1, keepdims=True)
-    xw = jnp.where(completes, _draw_xw(u3_init, min_lk), xw_ref[:, :])
+    xw = jnp.where(completes, _draw_xw(u3_init, min_lk), out_xw_ref[:, :])
 
     # ---- acceptance scan (weighted._update_one's while_loop) --------------
     j0 = jnp.sum(
         (prank < need).astype(jnp.int32), axis=1, keepdims=True
-    )  # searchsorted(prank, need, 'left')
-    start = jnp.where(need > 0, jnp.minimum(j0 + 1, block_b), 0)
+    )  # searchsorted(prank, need, 'left'), chunk-local
+    start = jnp.where(need > 0, jnp.minimum(j0 + 1, chunk_b), 0)
     cw_bits = jax.lax.bitcast_convert_type(cw, jnp.int32)
     base0_bits = _row_gather_bits(lane_b == (start - 1), cw_bits)
+    # start == 0 (fill already complete): continue from the carried
+    # tile-global base; chunk 0 carries the XLA base0 of 0.0
     base0 = jnp.where(
-        start > 0, jax.lax.bitcast_convert_type(base0_bits, jnp.float32), 0.0
+        start > 0,
+        jax.lax.bitcast_convert_type(base0_bits, jnp.float32),
+        base_ref[:, :],
     )
 
     def next_j(base, xw_c, cur):
@@ -194,18 +241,18 @@ def _kernel(
         x = base + xw_c  # [r, 1]
         mask = positive & (cw >= x) & (lane_b >= cur)
         return jnp.min(
-            jnp.where(mask, lane_b, block_b), axis=1, keepdims=True
+            jnp.where(mask, lane_b, chunk_b), axis=1, keepdims=True
         )
 
     def cond(carry):
         xw_c, base, cur = carry
-        return jnp.any(next_j(base, xw_c, cur) < block_b)
+        return jnp.any(next_j(base, xw_c, cur) < chunk_b)
 
     def body(carry):
         xw_c, base, cur = carry
-        j = next_j(base, xw_c, cur)  # [r, 1]
-        active = j < block_b
-        onehot_j = lane_b == j  # empty when j == block_b
+        j_l = next_j(base, xw_c, cur)  # [r, 1] chunk-local lane
+        active = j_l < chunk_b
+        onehot_j = lane_b == j_l  # empty when j_l == chunk_b
         w_c = jnp.sum(jnp.where(onehot_j, wf, 0.0), axis=1, keepdims=True)
         # next_j only returns positive-weight lanes, so active lanes use
         # the raw weight — bit-identical to the XLA path even for subnormal
@@ -213,7 +260,7 @@ def _kernel(
         # would trip jax_debug_nans
         w_safe = jnp.where(active, w_c, 1.0)
         e_bits = _row_gather_bits(onehot_j, elem_bits_all)
-        idx = count + 1 + j
+        idx = count + base_off + 1 + j_l
         _, w1_a, w2_a = counter_bits(k1, k2, idx, 3)
         u1 = uniform_from_bits(w1_a)
         u2 = uniform_from_bits(w2_a)
@@ -242,12 +289,19 @@ def _kernel(
         return (
             jnp.where(active, xw_n, xw_c),
             jnp.where(active, base_j, base),
-            jnp.where(active, j + 1, cur),
+            jnp.where(active, j_l + 1, cur),
         )
 
     xw, base, _cur = jax.lax.while_loop(cond, body, (xw, base0, start))
-    # carry the unconsumed jump across the tile boundary
-    out_xw_ref[:, :] = xw - (total_w - base)
+    out_xw_ref[:, :] = xw
+    base_ref[:, :] = base
+
+    # last chunk: carry the unconsumed jump across the tile boundary —
+    # total_w here is the TILE-global weight sum, base the global prefix
+    # at the last acceptance, both bit-identical to the XLA full-tile pass
+    @pl.when(j == n_chunks - 1)
+    def _rebase():
+        out_xw_ref[:, :] = xw - (total_w - base)
 
 
 def update_pallas(
@@ -256,6 +310,7 @@ def update_pallas(
     weights: jax.Array,
     *,
     block_r=None,
+    chunk_b: "int | None" = None,
     interpret: bool = False,
 ) -> WeightedState:
     """Full-tile weighted update, bit-identical to
@@ -263,6 +318,17 @@ def update_pallas(
 
     ``elems``/``weights`` are ``[R, B]``; requires :func:`supports`.
     ``interpret=True`` runs the Mosaic interpreter (CPU equivalence tests).
+    Geometry knobs (see :mod:`.autotune` for the persistent per-device
+    cache):
+
+    - ``block_r``: reservoir rows per grid cell (``None`` = VMEM-aware
+      auto-size, :func:`pick_block_r`); any R is accepted.
+    - ``chunk_b``: batch-streaming chunk — the tile's batch axis is split
+      into ``B // chunk_b`` grid cells whose HBM→VMEM loads Mosaic
+      double-buffers against the previous chunk's acceptance loop.
+      ``None``/0, a non-divisor of B, or a non-multiple of
+      ``prefix.CUMSUM_BLOCK`` (the shared cumsum association's block) =
+      whole tile in one cell.
     """
     R, k = state.samples.shape
     B = elems.shape[1]
@@ -277,8 +343,11 @@ def update_pallas(
             f"int32/float32/uint32 samples, elems dtype == samples dtype); "
             "use ops.weighted.update"
         )
+    from .blocking import resolve_chunk
+
+    chunk_b = resolve_chunk(B, chunk_b, multiple_of=CUMSUM_BLOCK)
     if block_r is None:
-        block_r = pick_block_r(R, k, B)
+        block_r = pick_block_r(R, k, chunk_b)
     R_orig = R
     if R % block_r != 0:
         from .blocking import pad_rows, shrink_block_to
@@ -300,27 +369,44 @@ def update_pallas(
     kd1, kd2 = key_words(state.key)  # [R] uint32 each
     key_data = jnp.stack([kd1, kd2], axis=1)  # [R, 2]
 
-    col = lambda i: (i, 0)  # noqa: E731 — row-block i, full second axis
+    # state blocks + carries: row-block i, chunk-invariant (VMEM-resident
+    # across the inner grid axis, written back once per row-block)
+    col = lambda i, j: (i, 0)  # noqa: E731
     col_spec = lambda w: pl.BlockSpec(  # noqa: E731
         (block_r, w), col, memory_space=pltpu.VMEM
     )
+    # the streamed inputs: chunk j of row-block i — the only blocks whose
+    # index varies along the inner grid axis, so Mosaic's pipeline
+    # double-buffers exactly these HBM->VMEM streams
+    stream_spec = pl.BlockSpec(
+        (block_r, chunk_b), lambda i, j: (i, j), memory_space=pltpu.VMEM
+    )
 
-    out_samples, out_lkeys, out_xw = pl.pallas_call(
-        functools.partial(_kernel, k=k, block_b=B),
-        grid=(R // block_r,),
+    out_samples, out_lkeys, out_xw, _base, _cwsum = pl.pallas_call(
+        functools.partial(
+            _kernel, k=k, chunk_b=chunk_b, n_chunks=B // chunk_b
+        ),
+        grid=(R // block_r, B // chunk_b),
         in_specs=[
             col_spec(k),
             col_spec(k),
             col_spec(1),
             col_spec(1),
             col_spec(2),
-            col_spec(B),
-            col_spec(B),
+            stream_spec,
+            stream_spec,
         ],
-        out_specs=(col_spec(k), col_spec(k), col_spec(1)),
+        out_specs=(
+            col_spec(k), col_spec(k), col_spec(1), col_spec(1), col_spec(1),
+        ),
         out_shape=(
             jax.ShapeDtypeStruct((R, k), state.samples.dtype),
             jax.ShapeDtypeStruct((R, k), jnp.float32),
+            jax.ShapeDtypeStruct((R, 1), jnp.float32),
+            # cross-chunk carries (acceptance base, cumsum fold) — outputs
+            # only so Mosaic keeps them VMEM-resident across the grid's
+            # inner axis; discarded after the call
+            jax.ShapeDtypeStruct((R, 1), jnp.float32),
             jax.ShapeDtypeStruct((R, 1), jnp.float32),
         ),
         interpret=interpret,

@@ -83,6 +83,91 @@ class TestCacheFile:
         autotune.record("cpu", 8, 4, 16, "int32", autotune.Geometry(4, 0, 0))
         assert autotune.lookup("cpu", 8, 4, 16, "int32").block_r == 4
 
+    def test_kernel_dimension_partitions_entries(self, cache):
+        # the same device+shape tunes independently per kernel: a weighted
+        # winner must never leak into the algl (or distinct) lookups
+        geoms = {
+            "algl": autotune.Geometry(64, 1024, 512),
+            "weighted": autotune.Geometry(128, 256, 0),
+            "distinct": autotune.Geometry(128, 512, 0),
+        }
+        for kernel, geom in geoms.items():
+            autotune.record(
+                "tpu v5e", 1024, 64, 2048, "int32", geom, kernel=kernel
+            )
+        for kernel, geom in geoms.items():
+            assert autotune.lookup(
+                "tpu v5e", 1024, 64, 2048, "int32", kernel=kernel
+            ) == geom
+        # record_if_better is kernel-scoped too: a faster weighted rate
+        # cannot displace the algl entry
+        assert autotune.record_if_better(
+            "tpu v5e", 1024, 64, 2048, "int32",
+            autotune.Geometry(8, 8, 8), elem_per_sec=1e12,
+            kernel="weighted",
+        )
+        assert autotune.lookup(
+            "tpu v5e", 1024, 64, 2048, "int32", kernel="algl"
+        ) == geoms["algl"]
+
+
+class TestSchemaMigration:
+    """v1 files (the algl-only era: bare keys, no ``_schema`` stamp) read
+    back as algl entries, and the first write persists the migration."""
+
+    def _write_v1(self, cache):
+        v1_key = "tpu v5e|R=65536|k=128|B=2048|int32"  # the v1 key form
+        with open(cache, "w") as f:
+            json.dump(
+                {v1_key: {"block_r": 64, "chunk_b": 1024,
+                          "gather_chunk": 512, "elem_per_sec": 2e10}},
+                f,
+            )
+        return v1_key
+
+    def test_v1_entries_read_as_algl(self, cache):
+        self._write_v1(cache)
+        assert autotune.lookup(
+            "tpu v5e", 65536, 128, 2048, "int32", kernel="algl"
+        ) == autotune.Geometry(64, 1024, 512)
+        # the migrated entry belongs to algl only
+        for kernel in ("weighted", "distinct"):
+            assert (
+                autotune.lookup(
+                    "tpu v5e", 65536, 128, 2048, "int32", kernel=kernel
+                )
+                is None
+            )
+
+    def test_first_record_persists_migration(self, cache):
+        v1_key = self._write_v1(cache)
+        autotune.record(
+            "tpu v5e", 4096, 256, 1024, "int32",
+            autotune.Geometry(128, 256, 0), kernel="distinct",
+        )
+        raw = json.load(open(cache))
+        assert raw["_schema"] == 2
+        assert v1_key not in raw  # rewritten under the kernel-keyed form
+        assert "algl|" + v1_key in raw
+        # both the migrated and the new entry survive the rewrite
+        assert autotune.lookup(
+            "tpu v5e", 65536, 128, 2048, "int32"
+        ) == autotune.Geometry(64, 1024, 512)
+        assert autotune.lookup(
+            "tpu v5e", 4096, 256, 1024, "int32", kernel="distinct"
+        ) == autotune.Geometry(128, 256, 0)
+
+    def test_v2_file_roundtrips_unchanged(self, cache):
+        autotune.record(
+            "cpu", 8, 4, 16, "int32", autotune.Geometry(8, 8, 0),
+            kernel="weighted",
+        )
+        raw = json.load(open(cache))
+        assert raw["_schema"] == 2
+        assert autotune.lookup(
+            "cpu", 8, 4, 16, "int32", kernel="weighted"
+        ) == autotune.Geometry(8, 8, 0)
+
 
 class TestEngineConsumption:
     R, k, B = 16, 8, 64
@@ -157,28 +242,128 @@ class TestEngineConsumption:
             np.asarray(e_pl._state.samples), np.asarray(e_xla._state.samples)
         )
 
-    def test_non_algl_modes_ignore_cache(self, cache):
+    def test_kernel_keyed_entries_route_to_their_engines(self, cache):
+        # an algl entry must NOT reach a weighted engine (kernel-keyed
+        # cache), and a weighted entry must — with the tuned geometry
+        # still bit-identical to the XLA path
+        import jax
+
+        device = jax.devices()[0].device_kind
+        autotune.record(
+            device, self.R, self.k, self.B, "int32",
+            autotune.Geometry(8, 16, 8),  # algl-only
+        )
+        planted_w = autotune.Geometry(8, 0, 0)
+        autotune.record(
+            device, self.R, self.k, self.B, "float32", planted_w,
+            kernel="weighted",
+        )
+
+        def weighted_engine(impl):
+            return ReservoirEngine(
+                SamplerConfig(
+                    max_sample_size=self.k,
+                    num_reservoirs=self.R,
+                    tile_size=self.B,
+                    weighted=True,
+                    sample_dtype="float32",
+                    impl=impl,
+                ),
+                key=0,
+            )
+
+        rng = np.random.default_rng(7)
+        tile = rng.uniform(-1, 1, (self.R, self.B)).astype(np.float32)
+        weights = rng.uniform(0.1, 2.0, (self.R, self.B)).astype(np.float32)
+        e_pl, e_xla = weighted_engine("pallas"), weighted_engine("xla")
+        e_pl.sample(tile, weights=weights)
+        e_xla.sample(tile, weights=weights)
+        assert list(e_pl._geometry_by_key.values()) == [planted_w]
+        np.testing.assert_array_equal(
+            np.asarray(e_pl._state.samples), np.asarray(e_xla._state.samples)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(e_pl._state.lkeys), np.asarray(e_xla._state.lkeys)
+        )
+
+    def test_distinct_engine_consumes_tuned_chunked_geometry(self, cache):
+        # a distinct entry with a real batch chunk: the engine compiles
+        # the 2-D grid and stays state-identical to the XLA sort-merge
+        import jax
+
+        planted = autotune.Geometry(8, 16, 0)
+        autotune.record(
+            jax.devices()[0].device_kind, self.R, self.k, self.B, "int32",
+            planted, kernel="distinct",
+        )
+
+        def distinct_engine(impl):
+            return ReservoirEngine(
+                SamplerConfig(
+                    max_sample_size=self.k,
+                    num_reservoirs=self.R,
+                    tile_size=self.B,
+                    distinct=True,
+                    impl=impl,
+                ),
+                key=0,
+            )
+
+        e_pl, e_xla = distinct_engine("pallas"), distinct_engine("xla")
+        rng = np.random.default_rng(11)
+        tile = rng.integers(0, 200, (self.R, self.B)).astype(np.int32)
+        e_pl.sample(tile)
+        e_xla.sample(tile)
+        assert list(e_pl._geometry_by_key.values()) == [planted]
+        np.testing.assert_array_equal(
+            np.asarray(e_pl._state.values), np.asarray(e_xla._state.values)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(e_pl._state.size), np.asarray(e_xla._state.size)
+        )
+
+    def test_bench_resolves_kernel_keyed_geometry(self, cache, monkeypatch):
+        # bench.py consults the same kernel-keyed cache at jit time: a
+        # planted weighted entry reaches the weighted bench geometry and
+        # never the algl one; env overrides still win
+        import jax
+
+        import bench
+
+        device = jax.devices()[0].device_kind
+        autotune.record(
+            device, 64, 8, 256, "int32", autotune.Geometry(8, 128, 0),
+            kernel="weighted",
+        )
+        monkeypatch.delenv("RESERVOIR_BENCH_BLOCK_R", raising=False)
+        monkeypatch.delenv("RESERVOIR_BENCH_CHUNK_B", raising=False)
+        monkeypatch.delenv("RESERVOIR_ALGL_CHUNK_B", raising=False)
+        assert bench._bench_geometry("weighted", 64, 8, 256) == (8, 128, 0)
+        # the algl lookup misses -> algl defaults (block 64, gather env)
+        block_r, chunk_b, _ = bench._bench_geometry("algl", 64, 8, 256)
+        assert (block_r, chunk_b) == (64, 0)
+        # kernel defaults when no entry exists for the other kernels
+        assert bench._bench_geometry("distinct", 64, 8, 256)[:2] == (0, 0)
+        monkeypatch.setenv("RESERVOIR_BENCH_CHUNK_B", "64")
+        assert bench._bench_geometry("weighted", 64, 8, 256) == (8, 64, 0)
+
+    def test_ignored_tuned_entry_logs_once(self, cache, caplog):
+        # satellite: a tuned entry that exists but cannot be used (the
+        # tile dispatched XLA) is logged once per engine, with the reason
+        import logging
+
         import jax
 
         autotune.record(
             jax.devices()[0].device_kind, self.R, self.k, self.B, "int32",
             autotune.Geometry(8, 16, 8),
         )
-        e = ReservoirEngine(
-            SamplerConfig(
-                max_sample_size=self.k,
-                num_reservoirs=self.R,
-                tile_size=self.B,
-                weighted=True,
-                impl="pallas",
-            ),
-            key=0,
-        )
-        rng = np.random.default_rng(7)
-        e.sample(
-            self._tile(),
-            weights=rng.uniform(0.1, 2.0, (self.R, self.B)).astype(
-                np.float32
-            ),
-        )
-        assert list(e._geometry_by_key.values()) == [None]
+        e = self._engine("auto")  # auto on CPU -> XLA path, entry ignored
+        with caplog.at_level(logging.INFO, logger="reservoir_tpu.engine"):
+            e.sample(self._tile())
+            e.sample(self._tile())  # same engine: no second log
+        msgs = [
+            r for r in caplog.records if "ignored" in r.getMessage()
+        ]
+        assert len(msgs) == 1, [r.getMessage() for r in caplog.records]
+        assert "algl" in msgs[0].getMessage()

@@ -199,12 +199,13 @@ class TestPairwiseSummaryMerges:
 class TestStreamMergers:
     def _stacked_uniform(self, D, R, k, N):
         states = []
+        step = jax.jit(al.update)  # D same-shape shard fills: one trace
         for d in range(D):
             st = al.init(jr.fold_in(jr.key(0), d), R, k)
             stream = jnp.tile(
                 jnp.arange(d * N, (d + 1) * N, dtype=jnp.int32), (R, 1)
             )
-            states.append(al.update(st, stream))
+            states.append(step(st, stream))
         return (
             jnp.stack([s.samples for s in states]),
             jnp.stack([s.count for s in states]),
@@ -230,10 +231,11 @@ class TestStreamMergers:
         D, R, k, N = 8, 8, 4, 100
         mesh = make_mesh(8, axis="stream")
         st_list = []
+        step = jax.jit(wd.update)  # D same-shape shard fills: one trace
         for d in range(D):
             st = wd.init(jr.fold_in(jr.key(1), d), R, k)
             elems = jnp.tile(jnp.arange(d * N, (d + 1) * N, dtype=jnp.int32), (R, 1))
-            st_list.append(wd.update(st, elems, jnp.ones((R, N), jnp.float32)))
+            st_list.append(step(st, elems, jnp.ones((R, N), jnp.float32)))
         sh = NamedSharding(mesh, P("stream"))
         stacked = [
             jax.device_put(jnp.stack([getattr(s, f) for s in st_list]), sh)
@@ -453,10 +455,11 @@ class TestTreeFoldUniformity:
     production fold, not a test-local reimplementation."""
 
     def _shards(self, R, k, D, N):
+        step = jax.jit(al.update)  # D same-shape shard fills: one trace
         out = []
         for d in range(D):
             st = al.init(jr.fold_in(jr.key(50), d), R, k)
-            st = al.update(
+            st = step(
                 st,
                 jnp.tile(
                     jnp.arange(d * N, (d + 1) * N, dtype=jnp.int32), (R, 1)

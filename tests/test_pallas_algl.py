@@ -43,10 +43,12 @@ def test_pallas_matches_vmap_sparse_accepts():
     # High count: most tiles see zero acceptances (the skip fast path).
     R, k, B = 8, 16, 64
     state, _ = _fill(jr.key(1), R, k, B)
-    # advance count far without touching samples: replay many tiles via XLA
+    # advance count far without touching samples: replay many tiles via
+    # XLA — jitted once, or the 30 replays pay 30 traces of wall time
+    step = jax.jit(al.update_steady)
     for s in range(30):
         batch = s * B + jax.lax.broadcasted_iota(jnp.int32, (R, B), 1)
-        state = al.update_steady(state, batch)
+        state = step(state, batch)
     batch = 999_000 + jax.lax.broadcasted_iota(jnp.int32, (R, B), 1)
     ref = al.update_steady(state, batch)
     got = alp.update_steady_pallas(state, batch, block_r=8, interpret=True)
@@ -125,8 +127,9 @@ def test_supports_gates():
 
 def test_non_divisible_r_pads_and_matches_xla():
     # any-R support (VERDICT r2 item 4): a partial last row-block rides as
-    # inert pad lanes; results are bit-identical to the XLA path
-    for R in (5, 13, 60):
+    # inert pad lanes; results are bit-identical to the XLA path (5 = sub-
+    # block shrink, 60 = multi-block partial tail; odd tails ride the fuzz)
+    for R in (5, 60):
         k, B = 8, 64
         state = al.init(jr.key(7), R, k)
         state = al.update(state, jax.lax.broadcasted_iota(jnp.int32, (R, B), 1))

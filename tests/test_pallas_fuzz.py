@@ -43,6 +43,10 @@ def _eq(a, b, fields):
 @pytest.mark.parametrize("R,k,B,steps", _CASES)
 def test_fuzz_weighted(R, k, B, steps):
     s_ref = s_pal = ww.init(jr.key(R * 1000 + k), R, k)
+    # chunk_b fuzzed with the shapes: only multiples of prefix.CUMSUM_BLOCK
+    # that divide B run a real multi-chunk grid (B=256 cases); everything
+    # else exercises the silent single-chunk fallback
+    chunk_b = _rand_chunk_b(B, R * 41 + k)
     for step in range(steps):
         key = jr.fold_in(jr.key(7), step)
         e = jr.randint(key, (R, B), 0, 1 << 30, jnp.int32)
@@ -51,27 +55,33 @@ def test_fuzz_weighted(R, k, B, steps):
         s_ref = ww.update(s_ref, e, w)
         # block_r=8: the default gate wants R % 64, but any divisor block
         # is legal — small blocks maximize grid-edge coverage here
-        s_pal = wp.update_pallas(s_pal, e, w, block_r=8, interpret=True)
+        s_pal = wp.update_pallas(
+            s_pal, e, w, block_r=8, chunk_b=chunk_b, interpret=True
+        )
     _eq(s_ref, s_pal, ("samples", "lkeys", "count", "xw"))
 
 
 @pytest.mark.parametrize("R,k,B,steps", _CASES)
 def test_fuzz_distinct(R, k, B, steps):
     s_ref = s_pal = dd.init(jr.key(R * 1000 + k + 1), R, k)
+    chunk_b = _rand_chunk_b(B, R * 43 + k)
     for step in range(steps):
         key = jr.fold_in(jr.key(9), step)
         b = jr.randint(key, (R, B), 0, max(4, R * B // 3), jnp.int32)
         s_ref = dd.update(s_ref, b)
-        s_pal = dp.update_pallas(s_pal, b, interpret=True)
+        s_pal = dp.update_pallas(s_pal, b, chunk_b=chunk_b, interpret=True)
     _eq(s_ref, s_pal, ("values", "hash_hi", "hash_lo", "size", "count"))
 
 
 def _rand_chunk_b(B: int, seed: int) -> int:
     """A random divisor-chunk of B (or a non-divisor — the kernel's
     full-tile fallback — ~1 time in 4): the 2-D grid decomposition is
-    fuzzed together with the shapes."""
+    fuzzed together with the shapes.  Divisors are floored at B/8 (at
+    most 8 grid cells per tile): the Mosaic interpreter replays the whole
+    kernel body per cell, so a chunk of 1 would cost B cell replays for
+    no extra boundary coverage."""
     rng = np.random.default_rng(seed)
-    divisors = [d for d in range(1, B + 1) if B % d == 0]
+    divisors = [d for d in range(1, B + 1) if B % d == 0 and d * 8 >= B]
     if rng.random() < 0.25:
         return int(rng.integers(1, B + 2))  # may or may not divide B
     return int(divisors[rng.integers(0, len(divisors))])

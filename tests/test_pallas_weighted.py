@@ -1,10 +1,10 @@
 """Weighted Pallas kernel == XLA vmap kernel, bit for bit (M4b).
 
 Same contract as ``tests/test_pallas_algl.py``: both implementations consume
-identical counter-keyed Threefry channels at the same absolute indices, so
-equality is exact when the weight partial sums are exact in float32 (integer
--valued weights) and within float-rounding otherwise.  Runs the Mosaic
-interpreter on the CPU test mesh.
+identical counter-keyed Threefry channels at the same absolute indices and
+share the blocked prefix-sum association of ``ops.prefix``, so equality is
+exact — for float weights too — across every (block_r, chunk_b) grid
+geometry.  Runs the Mosaic interpreter on the CPU test mesh.
 """
 
 import jax
@@ -57,7 +57,7 @@ def test_weighted_pallas_multi_tile_chain():
     # chained tiles: fill completing mid-stream, then steady acceptances
     R, k, B = 8, 8, 32
     s_ref = s_pal = ww.init(jr.key(5), R, k)
-    for step in range(6):
+    for step in range(4):
         elems = step * B + jax.lax.broadcasted_iota(jnp.int32, (R, B), 1)
         weights = _int_weights(jr.fold_in(jr.key(6), step), R, B)
         s_ref = ww.update(s_ref, elems, weights)
@@ -67,20 +67,16 @@ def test_weighted_pallas_multi_tile_chain():
         _assert_state_equal(s_ref, s_pal)
 
 
-def test_weighted_pallas_float_weights_close():
-    # non-integer weights: cumsum association may differ between the two
-    # lowerings, so parity is within float rounding, not bit-exact
+def test_weighted_pallas_float_weights_exact():
+    # non-integer weights: both paths share ops.prefix's blocked cumsum
+    # association, so parity is bit-exact even for float partial sums
     R, k, B = 8, 16, 64
     state = ww.init(jr.key(7), R, k)
     elems = jax.lax.broadcasted_iota(jnp.int32, (R, B), 1)
     weights = 0.25 + jr.uniform(jr.key(8), (R, B))
     ref = ww.update(state, elems, weights)
     got = wp.update_pallas(state, elems, weights, block_r=8, interpret=True)
-    # counts always exact; sizes (filled slots) too
-    np.testing.assert_array_equal(np.asarray(ref.count), np.asarray(got.count))
-    rs, rz = ww.result(ref)
-    gs, gz = ww.result(got)
-    np.testing.assert_array_equal(np.asarray(rz), np.asarray(gz))
+    _assert_state_equal(ref, got)
 
 
 def test_weighted_pallas_rejects_unsupported():
@@ -91,8 +87,9 @@ def test_weighted_pallas_rejects_unsupported():
 
 def test_weighted_pallas_any_r_pads_and_matches_xla():
     # any-R support: partial last row-blocks pad with zero-weight inert
-    # lanes; results stay bit-identical to XLA
-    for R in (6, 13, 60):
+    # lanes; results stay bit-identical to XLA (6 = sub-block shrink path,
+    # 60 = multi-block partial tail; 13-style odd tails ride the fuzz)
+    for R in (6, 60):
         k, B = 4, 64
         state = ww.init(jr.key(20), R, k)
         elems = jax.lax.broadcasted_iota(jnp.int32, (R, B), 1)
@@ -103,6 +100,104 @@ def test_weighted_pallas_any_r_pads_and_matches_xla():
         np.testing.assert_array_equal(np.asarray(ref.lkeys), np.asarray(got.lkeys))
         np.testing.assert_array_equal(np.asarray(ref.count), np.asarray(got.count))
         np.testing.assert_array_equal(np.asarray(ref.xw), np.asarray(got.xw))
+
+
+class TestGridPipelinedChunking:
+    """The 2-D grid (row-block × batch-chunk) restructure: draws are
+    counter-keyed at absolute indices and the weight prefix sum uses the
+    shared blocked association (ops.prefix), so every valid
+    (block_r, chunk_b) geometry is bit-identical to the XLA path — for
+    FLOAT weights too, not just exact integer sums — the acceptance-
+    criteria pin for the grid-pipelined weighted kernel."""
+
+    R, k, B = 8, 8, 256  # B = 2 cumsum blocks: real multi-chunk grids
+    # the XLA reference, jitted once for the class's one tile shape —
+    # un-jitted calls re-trace per test and dominate suite wall time
+    _ref_update = staticmethod(jax.jit(ww.update))
+
+    def _tiles(self, seed, zero_frac=0.3):
+        elems = jax.lax.broadcasted_iota(jnp.int32, (self.R, self.B), 1)
+        w = 0.25 + jr.uniform(jr.key(seed), (self.R, self.B))
+        if zero_frac:
+            w = w * (
+                jr.uniform(jr.key(seed + 1), (self.R, self.B)) > zero_frac
+            )
+        return elems, w
+
+    @pytest.mark.parametrize(
+        "block_r,chunk_b",
+        [
+            (8, 128),  # 2 chunks (the minimum legal chunk width)
+            (4, 128),  # 2 chunks, multi-row-block grid
+            (8, 256),  # single chunk (the pre-r7 shape)
+        ],
+    )
+    def test_geometries_match_xla(self, block_r, chunk_b):
+        # fill + first acceptances inside one tile, float weights: the
+        # fill->steady handoff and acceptance chains cross chunk
+        # boundaries at every decomposition
+        state = ww.init(jr.key(40), self.R, self.k)
+        elems, w = self._tiles(41)
+        ref = self._ref_update(state, elems, w)
+        got = wp.update_pallas(
+            state, elems, w, block_r=block_r, chunk_b=chunk_b,
+            interpret=True,
+        )
+        _assert_state_equal(ref, got)
+
+    def test_chunk_boundary_splits_zero_weight_run(self):
+        # pin the satellite case: a zero-weight run straddling the chunk
+        # boundary (lanes 120..136 around the 128 boundary) — the flat
+        # cumsum span and the "counted, never sampled" contract must
+        # survive the chunk handoff, mid-fill and in steady state
+        lane = np.arange(self.B)
+        zero_run = (lane >= 120) & (lane < 137)
+        s_ref = s_pal = ww.init(jr.key(42), self.R, self.k)
+        for step in range(3):
+            elems = step * self.B + jax.lax.broadcasted_iota(
+                jnp.int32, (self.R, self.B), 1
+            )
+            w = 0.5 + jr.uniform(
+                jr.fold_in(jr.key(43), step), (self.R, self.B)
+            )
+            w = jnp.where(jnp.asarray(zero_run)[None, :], 0.0, w)
+            s_ref = self._ref_update(s_ref, elems, w)
+            s_pal = wp.update_pallas(
+                s_pal, elems, w, block_r=8, chunk_b=128, interpret=True
+            )
+            _assert_state_equal(s_ref, s_pal)
+
+    def test_steady_acceptance_chain_across_chunks(self):
+        # warm reservoirs (via the XLA path — the kernels are
+        # bit-identical, so the states are shared), then a multi-chunk
+        # steady tile: the (xw, base) carry across grid cells must
+        # preserve every jump
+        warm = ww.init(jr.key(44), self.R, self.k)
+        warm_e, warm_w = self._tiles(45, zero_frac=0.0)
+        warm = self._ref_update(warm, warm_e, warm_w)
+        elems, w = self._tiles(46)
+        ref = self._ref_update(warm, elems, w)
+        got = wp.update_pallas(
+            warm, elems, w, block_r=8, chunk_b=128, interpret=True
+        )
+        _assert_state_equal(ref, got)
+
+    def test_invalid_chunks_fall_back_to_full_tile(self):
+        # a chunk that divides B but breaks the cumsum association (not a
+        # multiple of prefix.CUMSUM_BLOCK), and a non-divisor chunk: both
+        # silently run the single-chunk grid — never a crash, never a
+        # different result
+        from reservoir_tpu.ops.prefix import CUMSUM_BLOCK
+
+        assert CUMSUM_BLOCK == 128  # the association constant the 64 case pins
+        state = ww.init(jr.key(47), self.R, self.k)
+        elems, w = self._tiles(48)
+        ref = self._ref_update(state, elems, w)
+        for chunk_b in (64, 100):
+            got = wp.update_pallas(
+                state, elems, w, block_r=8, chunk_b=chunk_b, interpret=True
+            )
+            _assert_state_equal(ref, got)
 
 
 def test_pick_block_r():

@@ -18,8 +18,8 @@ import pytest
 
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, os.path.join(_REPO, "tools"))
-import tpu_algl_best_block  # noqa: E402
-import tpu_algl_block_sweep  # noqa: E402
+import tpu_best_block  # noqa: E402
+import tpu_block_sweep  # noqa: E402
 import tpu_capture_report  # noqa: E402
 import tpu_watch  # noqa: E402
 
@@ -178,20 +178,39 @@ def test_capture_report_renders_ab_verdict(tmp_path):
 def test_sweep_variant_parsing():
     # 3-part geometry triples, with the legacy 2-part block:gather form
     # (pre-r6 sweeps had no streaming chunk) mapping to chunk_b=0
-    assert tpu_algl_block_sweep._parse_variant("64:1024:512") == (
+    assert tpu_block_sweep._parse_variant("64:1024:512") == (
         64, 1024, 512
     )
-    assert tpu_algl_block_sweep._parse_variant("128:0:0") == (128, 0, 0)
-    assert tpu_algl_block_sweep._parse_variant("64:512") == (64, 0, 512)
-    assert tpu_algl_block_sweep._parse_variant("64") == (64, 0, 512)
+    assert tpu_block_sweep._parse_variant("128:0:0") == (128, 0, 0)
+    assert tpu_block_sweep._parse_variant("64:512") == (64, 0, 512)
+    assert tpu_block_sweep._parse_variant("64") == (64, 0, 512)
+
+
+def test_sweep_is_kernel_parameterized():
+    # every kernel has a sweep shape + default variant list, and the
+    # weighted defaults respect the cumsum-association chunk constraint
+    # (a non-multiple-of-128 chunk silently falls back to single-chunk —
+    # sweeping one would measure the fallback, not a new geometry)
+    from reservoir_tpu.ops.prefix import CUMSUM_BLOCK
+
+    assert set(tpu_block_sweep.SWEEP_SHAPES) == {
+        "algl", "weighted", "distinct"
+    }
+    assert set(tpu_block_sweep.DEFAULT_VARIANTS) == set(
+        tpu_block_sweep.SWEEP_SHAPES
+    )
+    for v in tpu_block_sweep.DEFAULT_VARIANTS["weighted"].split(","):
+        _, chunk, _ = tpu_block_sweep._parse_variant(v)
+        assert chunk % CUMSUM_BLOCK == 0, v
 
 
 def test_best_block_picks_triple_and_maps_legacy(tmp_path, monkeypatch):
-    # the winner is the fastest sanely-compiling geometry SINCE this run;
-    # legacy records (whose "chunk_b" was the gather window) read back as
+    # the winner is the fastest sanely-compiling geometry SINCE this run,
+    # FOR the requested kernel; legacy records (whose "chunk_b" was the
+    # gather window, and which carry no kernel field) read back as algl
     # (block, 0, gather); compile blowups and stale rows never win
     sweep = tmp_path / "TPU_BLOCK_SWEEP.jsonl"
-    monkeypatch.setattr(tpu_algl_best_block, "SWEEP", str(sweep))
+    monkeypatch.setattr(tpu_best_block, "SWEEP", str(sweep))
     rows = [
         # stale (before --since): would otherwise win
         {"ts": "2026-08-03T00:00:00", "result": {
@@ -210,20 +229,34 @@ def test_best_block_picks_triple_and_maps_legacy(tmp_path, monkeypatch):
         {"ts": "2026-08-04T00:02:00", "result": {
             "block_r": 128, "chunk_b": 1024, "gather_chunk": 512,
             "compile_plus_first_run_s": 500.0, "elem_per_sec": 9e10}},
+        # a weighted-kernel record, faster still: must not win the ALGL
+        # pick, and must be the WEIGHTED pick
+        {"ts": "2026-08-04T00:03:00", "kernel": "weighted", "result": {
+            "kernel": "weighted", "block_r": 128, "chunk_b": 256,
+            "gather_chunk": 0, "compile_plus_first_run_s": 20.0,
+            "elem_per_sec": 5e10, "device_kind": "tpu v5e",
+            "R": 16384, "k": 64, "B": 1024}},
     ]
     with open(sweep, "w") as f:
         for r in rows:
             f.write(json.dumps(r) + "\n")
-    best = tpu_algl_best_block.pick_best(120.0, since="2026-08-04")
+    best = tpu_best_block.pick_best(120.0, since="2026-08-04")
     assert best is not None
     variant, rate, res = best
     assert variant == (64, 1024, 512)
     assert rate == 2e10
     assert res["device_kind"] == "tpu v5e"
+    # the kernel-keyed pick routes to the weighted record
+    best_w = tpu_best_block.pick_best(
+        120.0, since="2026-08-04", kernel="weighted"
+    )
+    assert best_w is not None
+    assert best_w[0] == (128, 256, 0)
+    assert best_w[1] == 5e10
     # the legacy record mapped to a gather-only variant, not a stream chunk
-    assert tpu_algl_best_block._variant_of(rows[1]["result"]) == (64, 0, 512)
+    assert tpu_best_block._variant_of(rows[1]["result"]) == (64, 0, 512)
     # nothing usable since a later stamp -> None (watcher retries)
-    assert tpu_algl_best_block.pick_best(120.0, since="2026-08-05") is None
+    assert tpu_best_block.pick_best(120.0, since="2026-08-05") is None
 
 
 def test_window_budget_rehearsal(tmp_path, monkeypatch):
@@ -296,6 +329,69 @@ def test_window_budget_rehearsal(tmp_path, monkeypatch):
     assert dropped
     assert "stream" in still
     assert set(still) == set(queue) - set(captured)
+
+
+def test_post_steps_include_kernel_sweeps():
+    # the r7 queue: every kernel's geometry sweep rides the post-step
+    # list, budget-capped like the algl sweep, and sequentially BEFORE
+    # the best-block re-capture that consumes the sweep file
+    steps = {name: (cmd, timeout) for name, cmd, timeout, _ in
+             tpu_watch.POST_STEPS}
+    assert "weighted_sweep" in steps and "distinct_sweep" in steps
+    for kernel in ("weighted", "distinct"):
+        cmd, timeout = steps[f"{kernel}_sweep"]
+        assert cmd[-2:] == ["--kernel", kernel]
+        assert cmd[-3].endswith("tpu_block_sweep.py")
+        assert 0 < timeout <= 1800
+    order = [name for name, *_ in tpu_watch.POST_STEPS]
+    assert order.index("weighted_sweep") < order.index("algl_best_block")
+    assert order.index("distinct_sweep") < order.index("algl_best_block")
+
+
+def test_post_step_rehearsal_sequential_gating(tmp_path, monkeypatch):
+    # drive run_post_steps end-to-end against simulated children: the
+    # kernel sweeps run in order; a failure (distinct_sweep here) keeps
+    # itself AND everything after it for the next window, and the
+    # completed prefix is committed for durability
+    monkeypatch.setattr(tpu_watch, "REPO", str(tmp_path))
+    monkeypatch.setattr(
+        tpu_watch, "CAPTURE", str(tmp_path / "TPU_CAPTURE_r96.jsonl")
+    )
+    ran, committed = [], []
+    monkeypatch.setattr(
+        tpu_watch, "_commit_capture", lambda ctx: committed.append(ctx)
+    )
+
+    class _Proc:
+        returncode = 0
+        stdout = ""
+        stderr = ""
+
+    def fake_run(cmd, **kw):
+        name = " ".join(str(c) for c in cmd)
+        ran.append(name)
+        proc = _Proc()
+        if "distinct" in name:  # the simulated mid-queue failure
+            proc = _Proc()
+            proc.returncode = 1
+        return proc
+
+    monkeypatch.setattr(tpu_watch.subprocess, "run", fake_run)
+    remaining = tpu_watch.run_post_steps(list(tpu_watch.POST_STEPS))
+    # algl + weighted sweeps ran and were committed; distinct failed and
+    # carries over together with everything gated behind it
+    assert any("--kernel weighted" in r for r in ran)
+    assert [s[0] for s in remaining] == [
+        "distinct_sweep", "pallas_device_tests", "algl_best_block"
+    ]
+    assert committed == ["2 post-step(s) recorded"]
+    rows = [
+        json.loads(line)
+        for line in open(tmp_path / "TPU_CAPTURE_r96.jsonl")
+    ]
+    assert [r["post_step"] for r in rows] == [
+        "algl_block_sweep", "weighted_sweep", "distinct_sweep"
+    ]
 
 
 def test_budget_scale_env_shrinks_timeouts(monkeypatch):

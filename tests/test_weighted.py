@@ -14,6 +14,7 @@ import math
 import numpy as np
 import pytest
 
+import jax
 import jax.numpy as jnp
 import jax.random as jr
 
@@ -114,9 +115,10 @@ class TestDeviceKernel:
         weights = rng.integers(1, 8, (R, N)).astype(np.float32)  # f32-exact sums
         ref = wd.update(wd.init(jr.key(6), R, k), jnp.asarray(elems), jnp.asarray(weights))
         state = wd.init(jr.key(6), R, k)
+        step = jax.jit(wd.update)  # [1]*30 re-traces once per width, not 30x
         start = 0
         for b in tiles:
-            state = wd.update(
+            state = step(
                 state,
                 jnp.asarray(elems[:, start : start + b]),
                 jnp.asarray(weights[:, start : start + b]),
@@ -441,6 +443,7 @@ def test_device_zero_weight_mixed_magnitude_no_nan():
     R, k, B = 8, 16, 256
     rng = np.random.default_rng(7)
     st = wd.init(jr.key(0), R, k)
+    step = jax.jit(wd.update)  # one trace for the 30 tiles, not 30
     for _ in range(30):
         e = jnp.asarray(
             rng.integers(0, 1 << 30, (R, B), dtype=np.int64).astype(np.int32)
@@ -449,7 +452,7 @@ def test_device_zero_weight_mixed_magnitude_no_nan():
             rng.integers(-6, 6, (R, B))
         )
         w[rng.random((R, B)) < 0.4] = 0.0
-        st = wd.update(st, e, jnp.asarray(w))
+        st = step(st, e, jnp.asarray(w))
     assert not np.isnan(np.asarray(st.lkeys)).any()
     assert not np.isnan(np.asarray(st.xw)).any()
     samples, size = wd.result(st)

@@ -79,13 +79,14 @@ class TestWideOps:
         R, k, B = 64, 16, 256
         s32 = al.init(jr.key(0), R, k, count_dtype=jnp.int32)
         sw = al.init(jr.key(0), R, k, count_dtype=al.WIDE)
+        step_fn = jax.jit(al.update)  # one trace per count layout, not 4
         for step in range(4):
             tile = jnp.asarray(
                 np.random.default_rng(step).integers(0, 1 << 30, (R, B)),
                 jnp.int32,
             )
-            s32 = al.update(s32, tile)
-            sw = al.update(sw, tile)
+            s32 = step_fn(s32, tile)
+            sw = step_fn(sw, tile)
             np.testing.assert_array_equal(
                 np.asarray(s32.samples), np.asarray(sw.samples)
             )
@@ -135,13 +136,15 @@ class TestWideOps:
             )
             for t in range(steps)
         ]
+        steady = jax.jit(al.update_steady)  # one trace per layout, not 3
         for t in tiles:
-            sw = al.update_steady(sw, t)
+            sw = steady(sw, t)
 
         with _enable_x64(True):
             s64 = _lift_int64(base, shift)
+            steady64 = jax.jit(al.update_steady)
             for t in tiles:
-                s64 = al.update_steady(s64, t)
+                s64 = steady64(s64, t)
             np.testing.assert_array_equal(
                 np.asarray(sw.samples), np.asarray(s64.samples)
             )

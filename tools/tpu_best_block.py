@@ -1,0 +1,185 @@
+"""Re-capture a kernel's bench at the best swept geometry.
+
+Runs as a watcher post-step (sequentially gated: only after
+``tpu_block_sweep.py`` completed this run), reading the per-variant
+compile/throughput records it appended to ``TPU_BLOCK_SWEEP.jsonl``:
+pick the ``(block_r, chunk_b, gather_chunk)`` geometry with the highest
+steady-state throughput among this ``--kernel``'s variants that compiled
+sanely (compile+first-run under ``--max-compile-s``), refresh the
+persistent autotune cache with it (:mod:`reservoir_tpu.ops.autotune`,
+kernel-keyed — the cache the engine and bench consult at jit time), and —
+if it differs from the kernel's bench default — run one more ``bench.py``
+capture with the geometry env-pinned, via the watcher's own
+``capture_bench`` (same timeout-salvage, same capture file).  This turns
+one hardware window into the sweep evidence AND a headline number at the
+sweep's winner (VERDICT r3 item 2a), with no second window.
+
+Only records stamped at/after ``--since`` (default: the watcher's
+``TPU_WATCH_RUN_START`` env) count — the sweep file is append-only
+across rounds, and a stale record from an older kernel must never pick
+the winner.
+
+Exit 0 when there is genuinely nothing to do (this run's sweep found no
+variant beating the default); exit 1 when the sweep has not produced
+usable data yet, so the sequentially-gated watcher retries both next
+window.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SWEEP = os.path.join(REPO, "TPU_BLOCK_SWEEP.jsonl")
+# Per-kernel bench defaults (bench.py _bench_geometry without a cache
+# entry): algl pins block 64 + gather 512; weighted/distinct auto-size
+# the block (0) and run the whole tile in one chunk.
+DEFAULTS = {
+    "algl": (64, 0, 512),
+    "weighted": (0, 0, 0),
+    "distinct": (0, 0, 0),
+}
+# the sweep shapes the records default to when they omit R/k/B
+SWEEP_SHAPES = {
+    "algl": (65536, 128, 2048),
+    "weighted": (16384, 64, 1024),
+    "distinct": (4096, 256, 1024),
+}
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def _variant_of(res: dict) -> "tuple[int, int, int]":
+    """(block_r, chunk_b, gather_chunk) from a sweep result record.
+
+    Pre-r6 (algl-only) records carry no ``gather_chunk`` field: their
+    ``chunk_b`` WAS the gather window (streaming chunks didn't exist yet),
+    and records older still carry neither (full-width gathers).  The
+    since-gate normally excludes both; this mapping just keeps accidental
+    reads faithful."""
+    if "gather_chunk" in res:
+        return (
+            res["block_r"],
+            res.get("chunk_b", 0),
+            res["gather_chunk"],
+        )
+    return res["block_r"], 0, res.get("chunk_b", 0)
+
+
+def pick_best(
+    max_compile_s: float, since: str, kernel: str = "algl"
+) -> "tuple[tuple[int, int, int], float, dict] | None":
+    """((block_r, chunk_b, gather_chunk), elem_per_sec, result_record) of
+    ``kernel``'s best sanely-compiling variant, from the LATEST record per
+    variant stamped >= ``since`` (ISO timestamps compare
+    lexicographically); None without usable data.  Records without a
+    ``kernel`` field are from the algl-only sweep era."""
+    if not os.path.exists(SWEEP):
+        return None
+    per_variant: dict = {}
+    with open(SWEEP) as f:
+        for line in f:
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if since and rec.get("ts", "") < since:
+                continue
+            res = rec.get("result")
+            if not res or res.get("compile_plus_first_run_s", 1e9) > max_compile_s:
+                continue
+            if res.get("kernel", rec.get("kernel", "algl")) != kernel:
+                continue
+            per_variant[_variant_of(res)] = (res["elem_per_sec"], res)
+    if not per_variant:
+        return None
+    best = max(per_variant, key=lambda v: per_variant[v][0])  # ties: any
+    rate, res = per_variant[best]
+    return best, rate, res
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--kernel", default="algl", choices=sorted(DEFAULTS))
+    ap.add_argument("--max-compile-s", type=float, default=120.0)
+    ap.add_argument(
+        "--since",
+        default=os.environ.get("TPU_WATCH_RUN_START", ""),
+        help="ignore sweep records stamped before this ISO timestamp",
+    )
+    args = ap.parse_args()
+    best = pick_best(args.max_compile_s, args.since, kernel=args.kernel)
+    if best is None:
+        print(
+            f"no usable {args.kernel} sweep data for this run yet; retry "
+            "next window",
+            flush=True,
+        )
+        return 1
+    (block, chunk, gather), rate, res = best
+    default_r, default_k, default_b = SWEEP_SHAPES[args.kernel]
+    if res.get("device_kind"):
+        # make the winner the engine's live geometry for this device+shape
+        from reservoir_tpu.ops import autotune
+
+        refreshed = autotune.record_if_better(
+            res["device_kind"],
+            res.get("R", default_r),
+            res.get("k", default_k),
+            res.get("B", default_b),
+            "int32",
+            autotune.Geometry(block, chunk, gather),
+            elem_per_sec=rate,
+            source="tpu_best_block",
+            kernel=args.kernel,
+        )
+        print(
+            f"autotune cache {'refreshed' if refreshed else 'already best'}: "
+            f"{args.kernel} block {block} chunk {chunk} gather {gather}",
+            flush=True,
+        )
+    if (block, chunk, gather) == DEFAULTS[args.kernel]:
+        print(
+            f"default geometry {DEFAULTS[args.kernel]} is already the "
+            f"sweep winner ({rate:.3g} elem/s)",
+            flush=True,
+        )
+        return 0
+    print(
+        f"sweep winner: {args.kernel} block {block} chunk {chunk} gather "
+        f"{gather} ({rate:.3g} elem/s); re-capturing",
+        flush=True,
+    )
+    from tpu_watch import capture_bench
+
+    extra_env = {
+        # the selftest child inherits the knobs, so the winner's capture
+        # row carries parity+KS proven at the exact kernel geometry that
+        # produced the number
+        "RESERVOIR_BENCH_BLOCK_R": str(block),
+        "RESERVOIR_BENCH_CHUNK_B": str(chunk),
+    }
+    if args.kernel == "algl":
+        # the STREAM_CHUNK env is the kernel-level default the selftest's
+        # own pallas calls read; the gather window is algl-only
+        extra_env["RESERVOIR_ALGL_STREAM_CHUNK"] = str(chunk)
+        extra_env["RESERVOIR_ALGL_CHUNK_B"] = str(gather)
+    status = capture_bench(
+        f"{args.kernel}_block{block}_chunk{chunk}_g{gather}",
+        bench_config=args.kernel,
+        extra_env=extra_env,
+    )
+    print(
+        f"re-capture at {args.kernel} block {block} chunk {chunk} gather "
+        f"{gather}: {status}",
+        flush=True,
+    )
+    return 0 if status == "ok" else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,238 @@
+"""Pallas kernel geometry sweep on the live TPU, kernel-parameterized.
+
+Round 2 found block_r > 64 blew up Mosaic compile (>6 min, killed) for the
+Algorithm-L kernel; the kernels have since been restructured — chunked
+one-hot gathers (r4), the 2-D grid-pipelined batch streaming (r6 for algl,
+r7 for weighted/distinct) — so each variant is a full
+``(block_r, chunk_b, gather_chunk)`` geometry: ``chunk_b`` the
+batch-streaming chunk of the grid pipeline (0 = whole tile, the
+single-chunk shape) and ``gather_chunk`` the one-hot select window
+(algl only; 0 = full-width).  ``--kernel`` selects which Pallas path the
+sweep measures (``algl`` | ``weighted`` | ``distinct``) at that kernel's
+headline bench shape.  This script measures, per variant, compile wall
+time and steady-state throughput — each in a THROWAWAY subprocess with a
+hard timeout, so a compile blowup costs its timeout and is recorded, never
+inherited.  Appends JSON lines to ``TPU_BLOCK_SWEEP.jsonl`` AND records
+each sanely-compiling variant into the persistent autotune cache
+(:mod:`reservoir_tpu.ops.autotune`, kernel-keyed, best-rate-wins) — the
+cache the engine and bench consult at jit time, so a sweep winner becomes
+the live geometry without a code change.
+
+Usage (only sensible against a live TPU backend):
+    python tools/tpu_block_sweep.py [--kernel weighted] \
+        [--variants 128:0:0,128:512:0,128:256:0] [--timeout 420]
+"""
+
+from __future__ import annotations
+
+import argparse
+import datetime
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+OUT = os.path.join(REPO, "TPU_BLOCK_SWEEP.jsonl")
+# sweep shapes = each kernel's headline bench config (BASELINE.md /
+# bench.py defaults): (R, k, B, steps)
+SWEEP_SHAPES = {
+    "algl": (65536, 128, 2048, 50),
+    "weighted": (16384, 64, 1024, 50),
+    "distinct": (4096, 256, 1024, 50),
+}
+# Per-kernel default variant lists: the proven default first, then the
+# grid-pipeline chunks, then the open block questions.  algl keeps its
+# gather axis; weighted chunks must be multiples of prefix.CUMSUM_BLOCK
+# (128) — others silently fall back to the single-chunk grid.
+DEFAULT_VARIANTS = {
+    "algl": "64:0:512,64:1024:512,64:512:512,64:256:512,128:1024:512",
+    "weighted": "128:0:0,128:512:0,128:256:0,128:128:0,64:256:0",
+    "distinct": "128:0:0,128:512:0,128:256:0,128:128:0,64:256:0",
+}
+# compile-sanity bound for cache admission: a variant that took longer
+# than this to compile+first-run is recorded in the JSONL but never
+# becomes the engine's live geometry
+MAX_CACHE_COMPILE_S = 120.0
+
+_CHILD = r"""
+import json, sys, time, functools
+kernel = sys.argv[1]
+block_r = int(sys.argv[2]); chunk_b = int(sys.argv[3]); gather = int(sys.argv[4])
+import jax, jax.numpy as jnp, jax.random as jr
+import numpy as np
+SHAPES = {
+    "algl": (65536, 128, 2048, 50),
+    "weighted": (16384, 64, 1024, 50),
+    "distinct": (4096, 256, 1024, 50),
+}
+R, k, B, steps = SHAPES[kernel]
+
+if kernel == "algl":
+    from reservoir_tpu.ops import algorithm_l as al
+    from reservoir_tpu.ops import algorithm_l_pallas as alp
+    state = al.init(jr.key(0), R, k)
+    state = al.update(state, jax.lax.broadcasted_iota(jnp.int32, (R, B), 1))
+    step_fn = functools.partial(
+        alp.update_steady_pallas,
+        block_r=block_r or None, chunk_b=chunk_b or None, gather_chunk=gather,
+    )
+
+    def body(state, s, step0):
+        base = ((step0 + s) * B).astype(jnp.int32)
+        batch = base + jax.lax.broadcasted_iota(jnp.int32, (R, B), 1)
+        return step_fn(state, batch)
+elif kernel == "weighted":
+    from reservoir_tpu.ops import weighted as ww
+    from reservoir_tpu.ops import weighted_pallas as wp
+    state = ww.init(jr.key(0), R, k)
+    step_fn = functools.partial(
+        wp.update_pallas, block_r=block_r or None, chunk_b=chunk_b or None,
+    )
+
+    def body(state, s, step0):
+        base = ((step0 + s) * B).astype(jnp.int32)
+        batch = base + jax.lax.broadcasted_iota(jnp.int32, (R, B), 1)
+        weights = 1.0 + 0.5 * jnp.cos(batch.astype(jnp.float32) * 1e-3) ** 2
+        return step_fn(state, batch, weights)
+else:
+    from reservoir_tpu.ops import distinct as dd
+    from reservoir_tpu.ops import distinct_pallas as dp
+    state = dd.init(jr.key(0), R, k)
+    step_fn = functools.partial(
+        dp.update_pallas, block_r=block_r or None, chunk_b=chunk_b or None,
+    )
+
+    def body(state, s, step0):
+        # the bench's Zipf-ish duplication (bench.py _bench_distinct),
+        # keyed per step so the dedup path is stressed identically
+        sub = jr.fold_in(jr.fold_in(jr.key(99), step0), s)
+        u = jr.uniform(sub, (R, B), minval=1e-6)
+        batch = jnp.minimum(u ** (-1.0 / 0.1), 1e7).astype(jnp.int32)
+        return step_fn(state, batch)
+
+@functools.partial(jax.jit, donate_argnums=0)
+def run(state, step0):
+    def scan_body(state, s):
+        return body(state, s, step0), None
+    state, _ = jax.lax.scan(
+        scan_body, state, jnp.arange(steps, dtype=jnp.int32)
+    )
+    return state
+
+t0 = time.perf_counter()
+state = run(state, jnp.asarray(0, jnp.int32))
+int(np.asarray(jax.device_get(jax.tree.leaves(state)[0].ravel()[0])))
+compile_s = time.perf_counter() - t0
+times = []
+for r in (1, 2):
+    t0 = time.perf_counter()
+    state = run(state, jnp.asarray(r * steps, jnp.int32))
+    int(np.asarray(jax.device_get(jax.tree.leaves(state)[0].ravel()[0])))
+    times.append(time.perf_counter() - t0)
+print(json.dumps({
+    "kernel": kernel,
+    "block_r": block_r,
+    "chunk_b": chunk_b,
+    "gather_chunk": gather,
+    "compile_plus_first_run_s": round(compile_s, 2),
+    "elem_per_sec": R * B * steps / min(times),
+    "device_kind": jax.devices()[0].device_kind,
+    "R": R, "k": k, "B": B,
+}))
+"""
+
+
+def _parse_variant(variant: str) -> "tuple[int, int, int]":
+    """``block[:chunk[:gather]]`` -> (block_r, chunk_b, gather_chunk).
+    Two-part legacy form ``block:gather`` (pre-r6 algl sweeps had no
+    streaming chunk) maps to chunk_b=0."""
+    parts = [int(p) for p in variant.split(":")]
+    if len(parts) == 1:
+        return parts[0], 0, 512
+    if len(parts) == 2:
+        return parts[0], 0, parts[1]
+    return parts[0], parts[1], parts[2]
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument(
+        "--kernel",
+        default="algl",
+        choices=sorted(SWEEP_SHAPES),
+        help="which Pallas kernel to sweep (at its headline bench shape)",
+    )
+    ap.add_argument(
+        "--variants",
+        default=None,
+        help="comma-separated block_r:chunk_b:gather_chunk geometries "
+        "(chunk 0 = whole tile, gather 0 = full-width; default: the "
+        "kernel's DEFAULT_VARIANTS list)",
+    )
+    ap.add_argument("--timeout", type=float, default=420.0)
+    args = ap.parse_args()
+    variants = args.variants or DEFAULT_VARIANTS[args.kernel]
+    sweep_r, sweep_k, sweep_b, _ = SWEEP_SHAPES[args.kernel]
+    sys.path.insert(0, REPO)
+    from reservoir_tpu.ops import autotune
+
+    for variant in variants.split(","):
+        blk, chunk, gather = _parse_variant(variant)
+        t0 = time.time()
+        rec = {
+            "ts": datetime.datetime.now(datetime.timezone.utc).isoformat(),
+            "kernel": args.kernel,
+            "block_r": blk,
+            "chunk_b": chunk,
+            "gather_chunk": gather,
+        }
+        try:
+            proc = subprocess.run(
+                [sys.executable, "-c", _CHILD, args.kernel, str(blk),
+                 str(chunk), str(gather)],
+                capture_output=True,
+                timeout=args.timeout,
+                text=True,
+                cwd=REPO,
+            )
+            rec["wall_s"] = round(time.time() - t0, 1)
+            if proc.returncode == 0:
+                for line in reversed(proc.stdout.splitlines()):
+                    if line.startswith("{"):
+                        rec["result"] = json.loads(line)
+                        break
+            else:
+                rec["rc"] = proc.returncode
+                rec["stderr_tail"] = proc.stderr[-1500:]
+        except subprocess.TimeoutExpired:
+            rec["rc"] = "timeout"
+            rec["wall_s"] = round(time.time() - t0, 1)
+        res = rec.get("result")
+        if (
+            res
+            and res.get("compile_plus_first_run_s", 1e9) <= MAX_CACHE_COMPILE_S
+            and res.get("device_kind")
+        ):
+            # best-rate-wins: the cache ends the sweep holding the fastest
+            # sanely-compiling geometry for this kernel+device+shape
+            rec["cached"] = autotune.record_if_better(
+                res["device_kind"],
+                res.get("R", sweep_r),
+                res.get("k", sweep_k),
+                res.get("B", sweep_b),
+                "int32",
+                autotune.Geometry(blk, chunk, gather),
+                elem_per_sec=res["elem_per_sec"],
+                source="tpu_block_sweep",
+                kernel=args.kernel,
+            )
+        with open(OUT, "a") as f:
+            f.write(json.dumps(rec) + "\n")
+        print(rec, flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

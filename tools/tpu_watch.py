@@ -308,15 +308,42 @@ def _run_post_step(name: str, cmd: list[str], timeout_s: float, env=None) -> boo
     return rc == 0
 
 
-# Ordered follow-ups once every bench config is captured: the block sweep
-# (VERDICT r3 item 2a) and the device-gated Pallas parity suite (item 2c).
-# Each runs in its own child with a hard timeout so a tunnel drop or
-# Mosaic compile blowup is recorded, not inherited.
+# Ordered follow-ups once every bench config is captured: the geometry
+# sweeps (VERDICT r3 item 2a; kernel-parameterized since r7 so the
+# weighted/distinct grids get tuned in the same windows) and the
+# device-gated Pallas parity suite (item 2c).  Each runs in its own child
+# with a hard timeout — budget-capped like the bench configs — so a
+# tunnel drop or Mosaic compile blowup is recorded, not inherited.
 POST_STEPS: list[tuple[str, list[str], float, dict]] = [
     (
         "algl_block_sweep",
-        [sys.executable, os.path.join(REPO, "tools", "tpu_algl_block_sweep.py")],
+        [sys.executable, os.path.join(REPO, "tools", "tpu_block_sweep.py")],
         1800.0,
+        {},
+    ),
+    (
+        # the r7 grid-pipelined weighted/distinct kernels: populate the
+        # kernel-keyed autotune cache so the next engine/bench run on this
+        # device picks the swept geometry with no code change
+        "weighted_sweep",
+        [
+            sys.executable,
+            os.path.join(REPO, "tools", "tpu_block_sweep.py"),
+            "--kernel",
+            "weighted",
+        ],
+        1500.0,
+        {},
+    ),
+    (
+        "distinct_sweep",
+        [
+            sys.executable,
+            os.path.join(REPO, "tools", "tpu_block_sweep.py"),
+            "--kernel",
+            "distinct",
+        ],
+        1500.0,
         {},
     ),
     (
@@ -333,14 +360,32 @@ POST_STEPS: list[tuple[str, list[str], float, dict]] = [
         {"RESERVOIR_TPU_TEST_PLATFORM": "native"},
     ),
     (
-        # after the sweep: if a block beats 64, re-capture the headline at
-        # it — one window yields both the sweep AND its winner's number
+        # after the sweep: if a geometry beats the default, re-capture the
+        # headline at it — one window yields both the sweep AND its
+        # winner's number
         "algl_best_block",
-        [sys.executable, os.path.join(REPO, "tools", "tpu_algl_best_block.py")],
+        [sys.executable, os.path.join(REPO, "tools", "tpu_best_block.py")],
         2700.0,
         {},
     ),
 ]
+
+
+def run_post_steps(post_remaining: "list") -> "list":
+    """Run the remaining post-steps with SEQUENTIAL gating: a later step
+    may depend on an earlier one's output (best-block reads the sweep's
+    file), so the first failure keeps itself AND everything after it for
+    the next window.  Returns the steps still to run.  Extracted from the
+    watch loop so the post-step scheduler can be rehearsed without
+    hardware (``tests/test_tpu_watch.py``)."""
+    done_upto = 0
+    for step in post_remaining:
+        if not _run_post_step(step[0], step[1], step[2], step[3]):
+            break
+        done_upto += 1
+    if done_upto:
+        _commit_capture(f"{done_upto} post-step(s) recorded")
+    return post_remaining[done_upto:]
 
 
 def run_window(remaining: "list[str]") -> "tuple[list[str], list[str], bool]":
@@ -408,18 +453,7 @@ def main() -> int:
                 f"{total} cumulative"
             )
             if not dropped:
-                # SEQUENTIAL gating: a later step may depend on an earlier
-                # one's output (best-block reads the sweep's file), so the
-                # first failure keeps itself AND everything after it for
-                # the next window
-                done_upto = 0
-                for step in post_remaining:
-                    if not _run_post_step(step[0], step[1], step[2], step[3]):
-                        break
-                    done_upto += 1
-                if done_upto:
-                    _commit_capture(f"{done_upto} post-step(s) recorded")
-                post_remaining = post_remaining[done_upto:]
+                post_remaining = run_post_steps(post_remaining)
             if not remaining and not post_remaining:
                 print(f"[{_now()}] capture complete", flush=True)
                 return 0
