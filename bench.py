@@ -342,6 +342,16 @@ def _bench_bridge(S, k, B, steps, reps):
     stages = dict(m.snapshot()["stages"])
     stages["zero_copy"] = bridge._zero_copy
     stages["pipelined"] = pipelined
+    # robustness-plane counters (ISSUE 3): all zero on a healthy run — a
+    # nonzero value in an evidence row says the number was earned through
+    # retries/demotions and should be read accordingly
+    stages["faults"] = {
+        "retries": m.retries,
+        "watchdog_trips": m.watchdog_trips,
+        "recoveries": m.recoveries,
+        "demotions": m.demotions,
+        "checkpoints": m.checkpoints,
+    }
     return times, stages
 
 

@@ -24,7 +24,15 @@ from .config import (
     MAX_SIZE,
     SamplerConfig,
 )
-from .errors import AbruptStreamTermination, SamplerClosedError, StreamCancelled
+from .errors import (
+    AbruptStreamTermination,
+    CheckpointCorrupt,
+    FlushTimeout,
+    RetryPolicy,
+    SamplerClosedError,
+    StreamCancelled,
+    TransientDeviceError,
+)
 
 __version__ = "0.1.0"
 
@@ -54,6 +62,10 @@ __all__ = [
     "SamplerClosedError",
     "AbruptStreamTermination",
     "StreamCancelled",
+    "TransientDeviceError",
+    "FlushTimeout",
+    "CheckpointCorrupt",
+    "RetryPolicy",
     "Sampler",
     "sampler",
     "distinct",

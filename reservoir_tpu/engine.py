@@ -21,6 +21,12 @@ own stream.  The engine owns:
 :mod:`reservoir_tpu.ops.distinct` and ``weighted=True`` the A-ExpJ kernel of
 :mod:`reservoir_tpu.ops.weighted` (weights tile required per sample call),
 both behind the same lifecycle surface.
+
+Robustness (SURVEY §5 failure-detection row, ISSUE 3): every update carries
+the ``engine.update``/``engine.pallas`` fault-injection sites
+(:mod:`reservoir_tpu.utils.faults`, no-ops unless a plane is installed),
+and a runtime Pallas failure demotes the engine to the XLA path instead of
+killing the stream (see the class docstring).
 """
 
 from __future__ import annotations
@@ -38,6 +44,7 @@ from .errors import SamplerClosedError
 from .ops import algorithm_l as _algl
 from .ops import distinct as _distinct
 from .ops import weighted as _weighted
+from .utils import faults as _faults
 
 __all__ = ["ReservoirEngine"]
 
@@ -59,6 +66,19 @@ class ReservoirEngine:
         ``config.mesh_axis`` set; defaults to a 1-D mesh over all visible
         devices.  State shards over the reservoir axis; updates compile to
         collective-free SPMD; results gather over ICI (``parallel.sharded``).
+      faults: instance-scoped fault plane for the ``engine.update`` /
+        ``engine.pallas`` injection sites
+        (:mod:`reservoir_tpu.utils.faults`); ``None`` defers to the
+        globally installed plane — zero-overhead no-op when neither exists.
+
+    Graceful degradation (ISSUE 3): a *runtime* Pallas launch/compile
+    failure — a Mosaic lowering bug on a new device, a kernel-side OOM —
+    demotes the engine to the XLA path for the rest of its life (logged
+    once, counted in :attr:`demotions`) and re-runs the failed tile;
+    sampling continues instead of killing the stream.  Demotion is only
+    possible while the state buffers survived the failed call (donation
+    hands them to the runtime at execution; compile/lowering failures — the
+    common case — leave them alive).
     """
 
     def __init__(
@@ -69,6 +89,8 @@ class ReservoirEngine:
         hash_fn: Optional[Callable] = None,
         reusable: bool = False,
         mesh: Optional[jax.sharding.Mesh] = None,
+        *,
+        faults: Optional[Any] = None,
         _initial_state: Any = None,
     ) -> None:
         validate_max_sample_size(config.max_sample_size)
@@ -123,6 +145,13 @@ class ReservoirEngine:
         # cached jitted updates compile to collective-free SPMD programs.
         self._pallas_fallback_logged = False
         self._tuned_geometry_ignored_logged = False
+        self._faults = faults
+        # Pallas->XLA demotion state (graceful degradation, ISSUE 3)
+        self._demoted = False
+        self._demotion_logged = False
+        #: runtime Pallas failures absorbed by demoting to XLA (0 or 1 —
+        #: the first demotion is permanent for this engine)
+        self.demotions = 0
         self._mesh = None
         self._tile_sharding = None
         self._row_sharding = None
@@ -282,6 +311,8 @@ class ReservoirEngine:
         """None if the Pallas kernel takes the tile, else why not."""
         if self._config.impl == "xla":
             return "impl='xla' configured"
+        if self._demoted:
+            return "engine demoted to XLA after a runtime Pallas failure"
         if ragged:
             return "ragged tile (valid mask)"
         if self._map_fn is not None or self._hash_fn is not None:
@@ -427,8 +458,16 @@ class ReservoirEngine:
             kwargs["hash_fn"] = self._hash_fn
         return functools.partial(base, **kwargs)
 
-    def _update_fn(self, width: int, steady: bool, ragged: bool, tile_dtype):
-        use_pallas = self._pallas_eligible(steady, ragged, tile_dtype)
+    def _update_fn(
+        self,
+        width: int,
+        steady: bool,
+        ragged: bool,
+        tile_dtype,
+        use_pallas: Optional[bool] = None,
+    ):
+        if use_pallas is None:
+            use_pallas = self._pallas_eligible(steady, ragged, tile_dtype)
         cache_key = (width, steady, ragged, use_pallas)
         fn = self._jit_cache.get(cache_key)
         if fn is None:
@@ -449,6 +488,49 @@ class ReservoirEngine:
             self._jit_cache[cache_key] = fn
         return fn
 
+    # -------------------------------------------- Pallas->XLA demotion
+
+    def _state_alive(self) -> bool:
+        """False once any state buffer was consumed by a failed donated
+        call — demotion cannot re-run the tile then."""
+        for leaf in jax.tree.leaves(self._state):
+            is_deleted = getattr(leaf, "is_deleted", None)
+            if is_deleted is not None and is_deleted():
+                return False
+        return True
+
+    def _demote(self, exc: BaseException) -> None:
+        self._demoted = True
+        self.demotions += 1
+        if not self._demotion_logged:
+            self._demotion_logged = True
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "Pallas update failed (%s: %s); engine demoted to the XLA "
+                "path — sampling continues (logged once per engine)",
+                type(exc).__name__,
+                exc,
+            )
+
+    def _call_update(self, fn, use_pallas: bool, rebuild_xla, state, args):
+        """Run one jitted update, demoting the engine to XLA on a runtime
+        Pallas failure (graceful degradation).  ``rebuild_xla`` builds the
+        equivalent XLA update for the same tile shape; the failed tile is
+        re-run through it, so no element is lost to the demotion.  The
+        ``engine.pallas`` fault site fires only on the Pallas branch — it
+        is the deterministic stand-in for a Mosaic launch failure."""
+        if not use_pallas:
+            return fn(state, *args)
+        try:
+            _faults.fire("engine.pallas", self._faults)
+            return fn(state, *args)
+        except Exception as e:
+            if not self._state_alive():
+                raise  # buffers already donated: the tile cannot re-run
+            self._demote(e)
+            return rebuild_xla()(state, *args)
+
     def sample(
         self, tile: Any, valid: Optional[Any] = None, weights: Optional[Any] = None
     ) -> None:
@@ -456,6 +538,7 @@ class ReservoirEngine:
         the batched analog of ``Sampler.scala:248-259``).  Weighted engines
         additionally require a strictly positive ``[R, B]`` weight tile."""
         self._check_open()
+        _faults.fire("engine.update", self._faults)
         tile_host: Optional[np.ndarray] = None  # host part staged below
         weights_host: Optional[np.ndarray] = None
         if self._wide:
@@ -548,7 +631,11 @@ class ReservoirEngine:
             and not self._config.weighted
             and self._min_count >= self._config.max_sample_size
         )
-        fn = self._update_fn(width, steady, valid is not None, tile_dtype)
+        ragged = valid is not None
+        use_pallas = self._pallas_eligible(steady, ragged, tile_dtype)
+        fn = self._update_fn(
+            width, steady, ragged, tile_dtype, use_pallas=use_pallas
+        )
         valid_np: Optional[np.ndarray] = None
         if valid is not None:
             valid_np = np.array(valid, np.int32, copy=True)  # async-put safe
@@ -600,11 +687,22 @@ class ReservoirEngine:
             if weights is not None and weights_host is None:
                 weights = jax.device_put(weights, self._tile_sharding)
         args = (tile, weights) if self._config.weighted else (tile,)
+
+        def rebuild_xla():
+            return self._update_fn(
+                width, steady, ragged, tile_dtype, use_pallas=False
+            )
+
         if valid is None:
-            self._state = fn(self._state, *args)
+            self._state = self._call_update(
+                fn, use_pallas, rebuild_xla, self._state, args
+            )
             self._min_count += width
         else:
-            self._state = fn(self._state, *args, placed["valid"])
+            self._state = self._call_update(
+                fn, use_pallas, rebuild_xla, self._state,
+                args + (placed["valid"],),
+            )
             self._min_count += int(valid_np.min())
 
     def sample_all(self, tiles: Any) -> None:
@@ -696,6 +794,50 @@ class ReservoirEngine:
         finally:
             self._weights_prevalidated = False
 
+    def _fused_update_fn(
+        self, n_full: int, B: int, steady: bool, stream_dtype, use_pallas: bool
+    ):
+        """Build/cache the jitted ``lax.scan`` over ``n_full`` full tiles
+        (the fused-stream analog of :meth:`_update_fn`; shares the
+        demotion-rebuild contract — an XLA variant exists for every key)."""
+        cache_key = ("stream_fused", n_full, B, steady, use_pallas,
+                     np.dtype(stream_dtype).str)
+        fn = self._jit_cache.get(cache_key)
+        if fn is not None:
+            return fn
+        if use_pallas:
+            geometry = self._kernel_geometry(
+                self._kernel_name(), B, stream_dtype
+            )
+        else:
+            geometry = None
+            self._log_ignored_geometry(B, stream_dtype, steady, False)
+        self._geometry_by_key[cache_key] = geometry
+        base = self._base_update(steady, use_pallas, geometry)
+        weighted = self._config.weighted
+        wide = self._wide
+
+        def scan_fn(state, tiles, wtiles=None):
+            def body(st, xs):
+                if weighted:
+                    tile, wt = xs
+                    return base(st, tile, wt), None
+                if wide:
+                    hi, lo = xs
+                    return base(st, (hi, lo)), None
+                return base(st, xs), None
+
+            if weighted:
+                xs = (tiles, wtiles)
+            else:
+                xs = tiles  # wide mode: a (hi, lo) pair of [n, R, B]
+            state, _ = jax.lax.scan(body, state, xs)
+            return state
+
+        fn = jax.jit(scan_fn, donate_argnums=(0,))
+        self._jit_cache[cache_key] = fn
+        return fn
+
     def _sample_stream_fused(
         self,
         stream: np.ndarray,
@@ -706,6 +848,7 @@ class ReservoirEngine:
         """Every full tile in one jitted ``lax.scan``: host reshapes to
         ``[n, R, B]`` (a C-speed transpose copy), one async transfer ships
         it, one dispatch consumes it."""
+        _faults.fire("engine.update", self._faults)
         R = self._config.num_reservoirs
         # weights were already validated whole-array (incl. NaN rejection)
         # by sample_stream, the sole caller
@@ -725,40 +868,7 @@ class ReservoirEngine:
             and self._min_count >= self._config.max_sample_size
         )
         use_pallas = self._pallas_eligible(steady, False, stream.dtype)
-        cache_key = ("stream_fused", n_full, B, steady, use_pallas,
-                     np.dtype(stream.dtype).str)
-        fn = self._jit_cache.get(cache_key)
-        if fn is None:
-            if use_pallas:
-                geometry = self._kernel_geometry(
-                    self._kernel_name(), B, stream.dtype
-                )
-            else:
-                geometry = None
-                self._log_ignored_geometry(B, stream.dtype, steady, False)
-            self._geometry_by_key[cache_key] = geometry
-            base = self._base_update(steady, use_pallas, geometry)
-            weighted = self._config.weighted
-
-            def scan_fn(state, tiles, wtiles=None):
-                def body(st, xs):
-                    if weighted:
-                        tile, wt = xs
-                        return base(st, tile, wt), None
-                    if wide:
-                        hi, lo = xs
-                        return base(st, (hi, lo)), None
-                    return base(st, xs), None
-
-                if weighted:
-                    xs = (tiles, wtiles)
-                else:
-                    xs = tiles  # wide mode: a (hi, lo) pair of [n, R, B]
-                state, _ = jax.lax.scan(body, state, xs)
-                return state
-
-            fn = jax.jit(scan_fn, donate_argnums=(0,))
-            self._jit_cache[cache_key] = fn
+        fn = self._fused_update_fn(n_full, B, steady, stream.dtype, use_pallas)
         def to_tiles(arr):
             t = np.ascontiguousarray(arr.reshape(R, n_full, B).swapaxes(0, 1))
             if np.shares_memory(t, arr):
@@ -784,10 +894,20 @@ class ReservoirEngine:
             placed = jax.device_put(stage, jax.tree.map(lambda _: sh, stage))
         else:
             placed = jax.device_put(stage)
+        def rebuild_xla():
+            return self._fused_update_fn(
+                n_full, B, steady, stream.dtype, False
+            )
+
         if weights is not None:
-            self._state = fn(self._state, placed["tiles"], placed["weights"])
+            self._state = self._call_update(
+                fn, use_pallas, rebuild_xla, self._state,
+                (placed["tiles"], placed["weights"]),
+            )
         else:
-            self._state = fn(self._state, placed["tiles"])
+            self._state = self._call_update(
+                fn, use_pallas, rebuild_xla, self._state, (placed["tiles"],)
+            )
         self._min_count += n_full * B
 
     # ----------------------------------------------------------- checkpoints

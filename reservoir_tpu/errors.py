@@ -9,9 +9,21 @@ idiomatic Python exception types:
 - ``NullPointerException``      -> ``TypeError``    (missing/non-callable ``map``/``hash``)
 - ``IllegalStateException``     -> ``SamplerClosedError``
 - ``AbruptStageTerminationException`` -> ``AbruptStreamTermination``
+
+Beyond the reference's surface, the module carries the **failure taxonomy**
+of the robustness plane (SURVEY §5 failure-detection row): a device/transfer
+failure is either *transient* (:class:`TransientDeviceError` — worth
+retrying under a :class:`RetryPolicy`) or *fatal* (everything else — fails
+the stream through the tri-state completion protocol).  :class:`FlushTimeout`
+is the watchdog's verdict on a hung device and is deliberately fatal: the
+flush worker may be wedged inside the runtime, so a retry could never run.
 """
 
 from __future__ import annotations
+
+import dataclasses
+import random
+from typing import Tuple, Type
 
 
 class SamplerClosedError(RuntimeError):
@@ -37,3 +49,68 @@ class StreamCancelled(RuntimeError):
     Mirrors the non-``NonFailureCancellation`` branch of
     ``onDownstreamFinish`` (``SampleImpl.scala:48-54``).
     """
+
+
+class TransientDeviceError(RuntimeError):
+    """A device/transfer failure worth retrying (the *transient* half of the
+    failure taxonomy).  The bridge's flush worker retries these under its
+    :class:`RetryPolicy` before surfacing them; every other exception type is
+    fatal on first occurrence."""
+
+
+class FlushTimeout(RuntimeError):
+    """A device flush exceeded the bridge's watchdog budget.
+
+    Deliberately **fatal** (not a :class:`TransientDeviceError`): the flush
+    worker is presumed wedged inside the runtime call, so the watchdog fails
+    the materialized future through the tri-state completion protocol
+    instead of letting callers block forever on ``join``/``result``."""
+
+
+class CheckpointCorrupt(RuntimeError):
+    """A checkpoint file is truncated or corrupt (bad zip container, missing
+    or unparseable manifest) — typed so recovery tooling can distinguish
+    "re-take the checkpoint" from programming errors, instead of catching
+    raw numpy/zipfile internals."""
+
+
+@dataclasses.dataclass(frozen=True)
+class RetryPolicy:
+    """Bounded, jittered exponential backoff for *transient* flush failures.
+
+    Deterministic by construction: the jitter for attempt ``i`` is drawn
+    from ``random.Random((seed, i))``, so two runs with the same policy see
+    the same backoff schedule (the bit-exactness story extends to timing
+    decisions).
+
+    Attributes:
+      max_retries: retry attempts after the first failure (0 disables).
+      base_backoff_s: backoff before retry 1; doubles per attempt.
+      max_backoff_s: hard cap on any single backoff.
+      jitter: fraction of the backoff randomized (0 = fully deterministic
+        delay, 0.5 = uniform in ``[0.75, 1.25] * backoff``).
+      seed: jitter seed.
+      retryable_types: exception types considered transient.
+    """
+
+    max_retries: int = 3
+    base_backoff_s: float = 0.05
+    max_backoff_s: float = 2.0
+    jitter: float = 0.5
+    seed: int = 0
+    retryable_types: Tuple[Type[BaseException], ...] = (TransientDeviceError,)
+
+    def retryable(self, exc: BaseException) -> bool:
+        return isinstance(exc, self.retryable_types)
+
+    def backoff_s(self, attempt: int) -> float:
+        """Backoff before retry ``attempt`` (1-based), jittered and capped."""
+        base = min(
+            self.base_backoff_s * (2.0 ** (attempt - 1)), self.max_backoff_s
+        )
+        if not self.jitter:
+            return base
+        u = random.Random(f"{self.seed}:{attempt}").random()  # deterministic
+        return min(
+            base * (1.0 + self.jitter * (u - 0.5)), self.max_backoff_s
+        )

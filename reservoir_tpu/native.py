@@ -25,6 +25,8 @@ from typing import Optional
 
 import numpy as np
 
+from .utils import faults as _faults
+
 __all__ = ["NativeStaging", "load_library", "load_error", "algl_scan"]
 
 _NATIVE_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "_native")
@@ -302,6 +304,7 @@ class NativeStaging:
     def take(self, out_valid: np.ndarray) -> int:
         """The zero-copy drain: copy per-row fill counts into ``out_valid``
         and reset them.  Tile data is already in the attached buffers."""
+        _faults.fire("native.staging")
         if out_valid.shape != (self._S,) or out_valid.dtype != np.int32:
             raise ValueError(f"out_valid must be [{self._S}] int32")
         if not out_valid.flags["C_CONTIGUOUS"]:
@@ -329,6 +332,7 @@ class NativeStaging:
                    weights: Optional[np.ndarray] = None) -> int:
         """Append a contiguous chunk to one row; returns elements consumed
         (less than ``len(elems)`` when the row filled — drain and resume)."""
+        _faults.fire("native.staging")
         elems = np.ascontiguousarray(elems, self._dtype)
         if self._weighted != (weights is not None):
             raise ValueError("weights required iff staging is weighted")
@@ -360,6 +364,7 @@ class NativeStaging:
         """Demux (stream_id, element) pairs; returns pairs consumed (less
         than ``len(streams)`` when a target row filled mid-batch).  Raises on
         out-of-range stream ids."""
+        _faults.fire("native.staging")
         streams = np.ascontiguousarray(streams, np.int32)
         elems = np.ascontiguousarray(elems, self._dtype)
         if streams.shape != elems.shape or streams.ndim != 1:
@@ -424,6 +429,7 @@ class NativeStaging:
               out_weights: Optional[np.ndarray] = None) -> int:
         """Copy staged rows + fill counts into caller buffers and reset;
         returns total staged elements."""
+        _faults.fire("native.staging")
         # explicit raises, not asserts: these guard raw C memcpys and must
         # survive python -O
         if out_tile.shape != (self._S, self._B) or out_tile.dtype != self._dtype:

@@ -16,24 +16,54 @@ operator — :meth:`complete` (future succeeds with the per-stream samples),
 backstop failing it with :class:`AbruptStreamTermination`
 (``SampleImpl.scala:35-57``).
 
+Beyond the reference's protocol, the bridge carries the executable half of
+the SURVEY §5 failure-*recovery* story (ISSUE 3):
+
+- **retry**: the flush worker retries :class:`TransientDeviceError` under a
+  bounded, jittered :class:`~reservoir_tpu.errors.RetryPolicy` before
+  surfacing — an injected transient fault completes the stream with results
+  bit-identical to a clean run (state advances only on success);
+- **watchdog**: ``flush_timeout_s`` arms a per-flush (per-attempt) timer; a
+  hung device fails the materialized future with
+  :class:`~reservoir_tpu.errors.FlushTimeout` through the tri-state
+  protocol instead of wedging every caller;
+- **auto-checkpoint + journal replay**: ``checkpoint_dir`` snapshots engine
+  state atomically every ``checkpoint_every`` flushes and journals each
+  flushed tile to a spill file; :meth:`recover` rebuilds the bridge after a
+  crash and replays the journaled tail — reservoirs come back bit-identical
+  to an uninterrupted run (counter-keyed draws make replay exact);
+- **fault plane**: the ``bridge.demux`` / ``bridge.dispatch`` injection
+  sites (:mod:`reservoir_tpu.utils.faults`) make all of the above testable
+  deterministically, per-bridge (``faults=``) or globally
+  (``RESERVOIR_FAULTS``), at zero cost when disabled.
+
 Thread-safety contract matches the reference (``Sampler.scala:19``): one
 writer.  Wrap pushes in your own queue for multi-producer feeds.
 """
 
 from __future__ import annotations
 
+import os
 import queue
+import struct
 import threading
 import time
+import zlib
 from concurrent.futures import Future
-from typing import Any, List, Optional, Union
+from typing import Any, Iterator, List, Optional, Tuple, Union
 
 import numpy as np
 
 from ..config import SamplerConfig
 from ..engine import ReservoirEngine
-from ..errors import AbruptStreamTermination, SamplerClosedError
+from ..errors import (
+    AbruptStreamTermination,
+    FlushTimeout,
+    RetryPolicy,
+    SamplerClosedError,
+)
 from ..native import NativeStaging
+from ..utils import faults as _faults
 from ..utils.metrics import BridgeMetrics
 from ..utils.tracing import trace_span
 
@@ -58,18 +88,49 @@ class _FlushPipeline:
     still reading.  ``reserve()`` (sized to the tile count) blocks until
     a host tile is genuinely free: the worker releases a reservation only
     AFTER its flush completes.
+
+    Robustness plane (ISSUE 3): the worker retries *transient* flush
+    failures under ``retry_policy`` (bounded jittered backoff) before
+    surfacing them; ``watchdog_s`` arms a per-attempt timer that fails the
+    owner's future with :class:`FlushTimeout` when a flush hangs (the
+    worker is presumed wedged inside the runtime — the pipeline marks
+    itself wedged and every later ``reserve``/``join``/``close`` raises
+    instead of blocking forever); any terminal worker error is ALSO routed
+    to ``fail_cb`` immediately, so the stream fails with its cause even if
+    the producer never calls again.
     """
 
-    def __init__(self, fn, n_tiles: int = 2) -> None:
+    def __init__(
+        self,
+        fn,
+        n_tiles: int = 2,
+        retry_policy: Optional[RetryPolicy] = None,
+        watchdog_s: Optional[float] = None,
+        fail_cb=None,
+        metrics: Optional[BridgeMetrics] = None,
+    ) -> None:
         import weakref
 
         # weak method: the worker must not keep the bridge alive, or the
         # abrupt-termination __del__ backstop (SampleImpl.scala:56-57)
         # could never fire — a dead owner simply ends the pipeline
         self._fn = weakref.WeakMethod(fn)
+        self._fail_cb = (
+            weakref.WeakMethod(fail_cb) if fail_cb is not None else None
+        )
+        self._retry = retry_policy
+        self._watchdog_s = watchdog_s
+        self._metrics = metrics
         self._q: "queue.Queue" = queue.Queue()
         self._free = threading.Semaphore(n_tiles)
         self._error: Optional[BaseException] = None
+        self._wedged = False
+        self._inflight = False
+        # completion counters replace Queue.join so the watchdog can wake
+        # joiners a hung worker would otherwise block forever
+        self._cv = threading.Condition()
+        self._submitted = 0
+        self._done = 0
         self._thread = threading.Thread(target=self._run, daemon=True)
         self._thread.start()
 
@@ -77,46 +138,238 @@ class _FlushPipeline:
         while True:
             item = self._q.get()
             if item is None:
-                self._q.task_done()
+                self._mark_done()
                 return
             try:
                 fn = self._fn()
                 if fn is None:  # owner collected: discard remaining work
                     return
+                if self._error is None and not self._wedged:
+                    self._run_one(fn, item)
+            except BaseException as e:  # surfaced at next reserve/join...
                 if self._error is None:
-                    fn(*item)
-            except BaseException as e:  # surfaced at next reserve/join
-                self._error = e
+                    self._error = e
+                self._fatal(e)  # ...AND on the future, right now
             finally:
                 self._free.release()  # the tile is safe to demux into
-                self._q.task_done()
+                self._mark_done()
+
+    def _run_one(self, fn, item) -> None:
+        """One flush: watchdog-armed, transient failures retried."""
+        attempt = 0
+        while True:
+            timer: Optional[threading.Timer] = None
+            if self._watchdog_s is not None:
+                timer = threading.Timer(self._watchdog_s, self._trip_watchdog)
+                timer.daemon = True
+            with self._cv:
+                self._inflight = True
+            if timer is not None:
+                timer.start()
+            try:
+                fn(*item)
+                return
+            except BaseException as e:
+                policy = self._retry
+                if (
+                    policy is not None
+                    and not self._wedged
+                    and policy.retryable(e)
+                    and attempt < policy.max_retries
+                ):
+                    attempt += 1
+                    if self._metrics is not None:
+                        self._metrics.retries += 1
+                    time.sleep(policy.backoff_s(attempt))
+                    continue
+                raise
+            finally:
+                with self._cv:
+                    self._inflight = False
+                if timer is not None:
+                    timer.cancel()
+
+    def _trip_watchdog(self) -> None:
+        """Timer thread: the in-flight flush blew its budget.  Fail fast on
+        behalf of the (presumed wedged) worker."""
+        with self._cv:
+            if not self._inflight:
+                return  # the flush completed in the arm/cancel gap: benign
+            exc = FlushTimeout(
+                f"device flush exceeded the watchdog budget "
+                f"({self._watchdog_s:g}s); worker presumed wedged"
+            )
+            self._wedged = True
+            if self._error is None:
+                self._error = exc
+            if self._metrics is not None:
+                self._metrics.watchdog_trips += 1
+            self._cv.notify_all()
+        self._fatal(exc)
+
+    def _fatal(self, exc: BaseException) -> None:
+        """Terminal failure: fail the owner's future with the cause (the
+        tri-state protocol must resolve even if the producer is gone)."""
+        cb = self._fail_cb() if self._fail_cb is not None else None
+        if cb is not None:
+            cb(exc)
+
+    def _mark_done(self) -> None:
+        with self._cv:
+            self._done += 1
+            self._cv.notify_all()
 
     def _check(self) -> None:
         if self._error is not None:
             err, self._error = self._error, None
             raise err
+        if self._wedged:
+            # the first caller got the original FlushTimeout above; the
+            # pipeline stays unusable (its worker is stuck in the runtime)
+            raise FlushTimeout("flush pipeline wedged past its watchdog")
 
     def reserve(self) -> None:
         """Block until a host tile is free to demux into (call BEFORE
-        draining into the tile that will be submitted)."""
+        draining into the tile that will be submitted).  Polls so a
+        watchdog trip unblocks a producer waiting on a permit the wedged
+        worker will never release."""
         self._check()
-        self._free.acquire()
+        while not self._free.acquire(timeout=0.1):
+            self._check()
 
     def release(self) -> None:
         """Return an unused reservation (the drain produced nothing)."""
         self._free.release()
 
     def submit(self, *args) -> None:
+        with self._cv:
+            self._submitted += 1
         self._q.put(args)
 
     def join(self) -> None:
-        self._q.join()
+        with self._cv:
+            while (
+                self._done < self._submitted
+                and self._error is None
+                and not self._wedged
+            ):
+                self._cv.wait()
         self._check()
 
     def close(self) -> None:
         if self._thread.is_alive():
             self._q.put(None)
-            self._thread.join(timeout=30)
+            with self._cv:
+                self._submitted += 1  # the sentinel is counted when drained
+            # a wedged worker is stuck inside a runtime call and may never
+            # reach the sentinel — don't block teardown on it
+            self._thread.join(timeout=1.0 if self._wedged else 30)
+        # An exception raised on the FINAL flush used to be silently lost
+        # here when the owner closed without another reserve()/join();
+        # close() is a completion barrier and must re-raise it (the
+        # bridge's __del__ routes it through fail() instead of raising
+        # mid-teardown).
+        self._check()
+
+
+class _FlushJournal:
+    """Append-only spill of flushed tiles since the last checkpoint.
+
+    Each record frames one flush: ``MAGIC | seq:u64 | payload_len:u32 |
+    payload | crc32(payload):u32`` where the payload is the ``valid``
+    int32[S] counts, the ``[S, B]`` tile bytes, and (weighted bridges) the
+    float32 weight tile.  Appends are flushed to the OS per record, so a
+    *process* crash loses nothing already journaled; an OS/power crash may
+    cost the tail record, which :meth:`replay` detects (short read or CRC
+    mismatch, necessarily the last record) and cleanly ignores — the
+    producer re-pushes from the durable watermark.
+
+    The journal is rotated (truncated) after every successful checkpoint;
+    records also carry ``seq`` so a crash *between* checkpoint write and
+    rotation is safe: recovery filters out records the checkpoint already
+    covers instead of double-applying them.
+    """
+
+    _MAGIC = b"RTJL"
+    _HEADER = struct.Struct("<4sQI")
+
+    def __init__(
+        self, path: str, num_streams: int, tile_width: int, dtype, weighted: bool
+    ) -> None:
+        self._path = path
+        self._S = int(num_streams)
+        self._B = int(tile_width)
+        self._dtype = np.dtype(dtype)
+        self._weighted = weighted
+        self._fh = open(path, "ab")
+
+    def append(
+        self,
+        seq: int,
+        tile: np.ndarray,
+        valid: np.ndarray,
+        wtile: Optional[np.ndarray],
+    ) -> None:
+        payload = valid.tobytes() + tile.tobytes()
+        if wtile is not None:
+            payload += wtile.tobytes()
+        self._fh.write(self._HEADER.pack(self._MAGIC, seq, len(payload)))
+        self._fh.write(payload)
+        self._fh.write(struct.pack("<I", zlib.crc32(payload)))
+        self._fh.flush()
+
+    def rotate(self) -> None:
+        """Drop every record (a fresh checkpoint now covers them)."""
+        self._fh.seek(0)
+        self._fh.truncate()
+        self._fh.flush()
+
+    def close(self) -> None:
+        self._fh.close()
+
+    @classmethod
+    def replay(
+        cls, path: str, num_streams: int, tile_width: int, dtype, weighted: bool
+    ) -> Iterator[Tuple[int, np.ndarray, np.ndarray, Optional[np.ndarray]]]:
+        """Yield ``(seq, tile, valid, wtile)`` for every intact record,
+        stopping cleanly at the first truncated/corrupt one."""
+        dtype = np.dtype(dtype)
+        S, B = int(num_streams), int(tile_width)
+        n_valid = S * 4
+        n_tile = S * B * dtype.itemsize
+        expect = n_valid + n_tile + (S * B * 4 if weighted else 0)
+        try:
+            fh = open(path, "rb")
+        except FileNotFoundError:
+            return
+        with fh:
+            while True:
+                head = fh.read(cls._HEADER.size)
+                if len(head) < cls._HEADER.size:
+                    return
+                magic, seq, plen = cls._HEADER.unpack(head)
+                if magic != cls._MAGIC or plen != expect:
+                    return
+                payload = fh.read(plen)
+                crc = fh.read(4)
+                if len(payload) < plen or len(crc) < 4:
+                    return
+                if zlib.crc32(payload) != struct.unpack("<I", crc)[0]:
+                    return
+                valid = np.frombuffer(payload, np.int32, S).copy()
+                tile = (
+                    np.frombuffer(payload, dtype, S * B, n_valid)
+                    .reshape(S, B)
+                    .copy()
+                )
+                wtile = (
+                    np.frombuffer(payload, np.float32, S * B, n_valid + n_tile)
+                    .reshape(S, B)
+                    .copy()
+                    if weighted
+                    else None
+                )
+                yield int(seq), tile, valid, wtile
 
 
 class DeviceStreamBridge:
@@ -138,6 +391,26 @@ class DeviceStreamBridge:
         demux fills tile B while tile A's transfer+dispatch is in flight
         on a worker thread (double buffering; default on).  ``False``
         restores the fully synchronous single-tile path.
+      retry_policy: bounded jittered backoff for *transient* flush
+        failures (:class:`~reservoir_tpu.errors.TransientDeviceError`) on
+        the pipelined worker; defaults to ``RetryPolicy()``.  Fatal errors
+        (everything else) surface on first occurrence.
+      flush_timeout_s: per-flush watchdog budget (pipelined bridges).  A
+        flush exceeding it fails the future with
+        :class:`~reservoir_tpu.errors.FlushTimeout` instead of wedging
+        callers on a hung device.  ``None`` (default) disables the
+        watchdog.
+      checkpoint_dir: directory for crash recovery.  When set, the bridge
+        snapshots engine state there atomically every ``checkpoint_every``
+        flushes (``engine.npz``) and journals each flushed tile to
+        ``journal.bin``; :meth:`recover` rebuilds the bridge bit-exactly
+        after a crash.  ``None`` (default) disables — the journal copy per
+        flush is the durability cost, paid only when asked for.
+      checkpoint_every: auto-checkpoint cadence in flushes (default 64).
+      faults: per-bridge :class:`~reservoir_tpu.utils.faults.FaultPlane`
+        for the ``bridge.*``/``engine.*`` injection sites; ``None`` defers
+        to the globally installed plane (``RESERVOIR_FAULTS``) — and when
+        neither exists every site is a zero-overhead no-op.
     """
 
     def __init__(
@@ -149,15 +422,26 @@ class DeviceStreamBridge:
         reusable: bool = False,
         mesh: Optional[Any] = None,
         pipelined: bool = True,
+        *,
+        retry_policy: Optional[RetryPolicy] = None,
+        flush_timeout_s: Optional[float] = None,
+        checkpoint_dir: Optional[str] = None,
+        checkpoint_every: int = 64,
+        faults: Optional[Any] = None,
+        _engine: Optional[ReservoirEngine] = None,
     ) -> None:
         self._config = config
-        self._engine = ReservoirEngine(
+        self._faults = faults
+        # _engine is the recovery path (recover() restores it from the
+        # checkpoint); normal construction builds a fresh one
+        self._engine = _engine if _engine is not None else ReservoirEngine(
             config,
             key=key,
             map_fn=map_fn,
             hash_fn=hash_fn,
             reusable=reusable,
             mesh=mesh,
+            faults=faults,
         )
         self._reusable = reusable
         S, B = config.num_reservoirs, config.tile_size
@@ -199,16 +483,46 @@ class DeviceStreamBridge:
                 self._tiles[0],
                 self._wtiles[0] if self._wtiles is not None else None,
             )
+        self._future: Future = Future()
+        self._metrics = BridgeMetrics()
+        self._metrics.demux_threads = self._staging.threads()
+        self._retry_policy = (
+            retry_policy if retry_policy is not None else RetryPolicy()
+        )
         self._pipeline = (
             _FlushPipeline(
-                self._dispatch_flush, n_tiles=1 if self._zero_copy else 2
+                self._dispatch_flush,
+                n_tiles=1 if self._zero_copy else 2,
+                retry_policy=self._retry_policy,
+                watchdog_s=flush_timeout_s,
+                fail_cb=self.fail,
+                metrics=self._metrics,
             )
             if pipelined
             else None
         )
-        self._future: Future = Future()
-        self._metrics = BridgeMetrics()
-        self._metrics.demux_threads = self._staging.threads()
+        # ------------------------------------------- crash recovery plane
+        self._ckpt_dir = checkpoint_dir
+        self._ckpt_every = max(1, int(checkpoint_every))
+        self._flush_seq = 0  # flushes journaled/checkpointed so far
+        self._journal: Optional[_FlushJournal] = None
+        self._ckpt_failed_logged = False
+        if checkpoint_dir is not None:
+            os.makedirs(checkpoint_dir, exist_ok=True)
+            self._journal = _FlushJournal(
+                os.path.join(checkpoint_dir, "journal.bin"),
+                S,
+                B,
+                dtype,
+                config.weighted,
+            )
+            if _engine is None:
+                # seq-0 anchor: recovery must be possible from flush one
+                # (it carries the config and the key-derived initial
+                # state), and it keeps recovery possible even if every
+                # later periodic checkpoint write fails — the journal
+                # then simply grows from here
+                self._save_snapshot()
 
     # ------------------------------------------------------------ properties
 
@@ -246,6 +560,7 @@ class DeviceStreamBridge:
         """Buffer one element or a 1-D chunk for logical stream ``stream``;
         flushes automatically whenever the stream's row fills."""
         self._check_open()
+        _faults.fire("bridge.demux", self._faults)
         self._metrics.start()
         arr = np.atleast_1d(np.asarray(elements, self._tiles[0].dtype))
         warr = self._check_weights(arr, weights)
@@ -271,6 +586,7 @@ class DeviceStreamBridge:
         helper when available (C-speed pointer walk; numpy fallback
         otherwise), flushing whenever a row fills mid-batch."""
         self._check_open()
+        _faults.fire("bridge.demux", self._faults)
         self._metrics.start()
         # conversions up front so the resume-loop slices stay no-copy; shape
         # and range validation belongs to NativeStaging (single owner)
@@ -314,6 +630,36 @@ class DeviceStreamBridge:
         self._metrics.start()
         self.drain_barrier()  # engine is single-writer: wait out the worker
         tile = np.asarray(tile)
+        if self._journal is not None:
+            # journal replay re-applies the exact bytes; a dtype the
+            # staging tiles don't carry could not round-trip bit-exactly
+            if tile.dtype != self._tiles[0].dtype:
+                raise ValueError(
+                    f"an auto-checkpointing bridge requires push_tile tiles "
+                    f"of the configured element dtype "
+                    f"{self._tiles[0].dtype}, got {tile.dtype}"
+                )
+            valid_arr = (
+                np.full(tile.shape[0], tile.shape[1], np.int32)
+                if valid is None
+                else np.ascontiguousarray(valid, np.int32)
+            )
+            wtile_arr = (
+                np.ascontiguousarray(weights, np.float32)
+                if self._wtiles is not None
+                else None
+            )
+            self._flush_seq += 1
+            self._journal.append(
+                self._flush_seq,
+                np.ascontiguousarray(tile),
+                valid_arr,
+                wtile_arr,
+            )
+            # normalize the live call to the journaled form (explicit
+            # valid counts) so replay re-executes the exact same engine
+            # code path — the bit-exactness contract of recover()
+            valid = valid_arr
         with trace_span("reservoir_bridge_flush"):
             self._engine.sample(tile, valid=valid, weights=weights)
         n = int(tile.shape[1]) * tile.shape[0] if valid is None else int(
@@ -322,9 +668,18 @@ class DeviceStreamBridge:
         self._metrics.elements += n
         self._metrics.flushed_elements += n
         self._metrics.flushes += 1
+        self._metrics.demotions = self._engine.demotions
+        self._maybe_checkpoint()
 
     def _dispatch_flush(self, tile, valid, wtile) -> None:
-        """The device half of a flush (worker thread when pipelined)."""
+        """The device half of a flush (worker thread when pipelined).
+
+        The ``bridge.dispatch`` fault site fires BEFORE the engine update:
+        an injected transient failure is retried by the pipeline worker
+        and, because engine state only advances on a successful update,
+        the retried stream completes bit-identical to a clean run.
+        """
+        _faults.fire("bridge.dispatch", self._faults)
         t0 = time.perf_counter()
         with trace_span("reservoir_bridge_flush"):
             if wtile is not None:
@@ -336,6 +691,9 @@ class DeviceStreamBridge:
             else:
                 self._engine.sample(tile, valid=valid)
         self._metrics.dispatch_s += time.perf_counter() - t0
+        # surface graceful degradation: a mid-stream Pallas->XLA demotion
+        # happens inside the engine; mirror it onto the bridge counters
+        self._metrics.demotions = self._engine.demotions
 
     def flush(self) -> None:
         """Dispatch buffered elements (ragged tile) to the device.
@@ -357,6 +715,13 @@ class DeviceStreamBridge:
             self._metrics.drain_s += time.perf_counter() - t0
             if total == 0:
                 return
+            # journal BEFORE handing the tile to the worker: the producer
+            # still owns it here (the worker reads the other tile), and a
+            # dispatch that later fails fatally was still journaled — so
+            # recover() replays it and no flushed element is ever lost
+            self._flush_seq += 1
+            if self._journal is not None:
+                self._journal.append(self._flush_seq, tile, valid, wtile)
             if self._pipeline is not None:
                 # wait until the OTHER tile's previous flight is done,
                 # then swap the demux onto it
@@ -373,6 +738,7 @@ class DeviceStreamBridge:
                 self._dispatch_flush(tile, valid, wtile)
             self._metrics.flushes += 1
             self._metrics.flushed_elements += total
+            self._maybe_checkpoint()
             return
         if self._pipeline is not None:
             # block until the tile we are about to drain into is truly
@@ -388,6 +754,9 @@ class DeviceStreamBridge:
             if self._pipeline is not None:
                 self._pipeline.release()
             return
+        self._flush_seq += 1
+        if self._journal is not None:
+            self._journal.append(self._flush_seq, tile, valid, wtile)
         if self._pipeline is not None:
             self._pipeline.submit(tile, valid, wtile)
             self._buf = 1 - i  # demux continues into the other tile
@@ -395,11 +764,157 @@ class DeviceStreamBridge:
             self._dispatch_flush(tile, valid, wtile)
         self._metrics.flushes += 1
         self._metrics.flushed_elements += total
+        self._maybe_checkpoint()
 
     def drain_barrier(self) -> None:
         """Wait for any in-flight pipelined flush (re-raising its error)."""
         if self._pipeline is not None:
             self._pipeline.join()
+
+    # -------------------------------------------------------- crash recovery
+
+    @property
+    def flushed_seq(self) -> int:
+        """Durable flush watermark: every flush with sequence number
+        ``<= flushed_seq`` is covered by the checkpoint+journal pair and
+        survives a crash.  Producers resume pushing from here after
+        :meth:`recover` (elements staged but never flushed are not
+        recoverable — they never left the producer's custody)."""
+        return self._flush_seq
+
+    def _save_snapshot(self) -> None:
+        """Checkpoint engine state covering every flush ``<= _flush_seq``
+        (atomic: temp file + rename inside ``utils.checkpoint``), then drop
+        the journal records the snapshot covers.  Both crash windows are
+        safe: a crash mid-write leaves the previous checkpoint intact, a
+        crash between write and rotation leaves only records recovery
+        filters out by sequence number."""
+        from ..utils.checkpoint import save_engine
+
+        save_engine(
+            os.path.join(self._ckpt_dir, "engine.npz"),
+            self._engine,
+            metadata={
+                "bridge": {
+                    "seq": self._flush_seq,
+                    "reusable": self._reusable,
+                    "pipelined": self._pipeline is not None,
+                    "checkpoint_every": self._ckpt_every,
+                    "elements": self._metrics.elements,
+                    "flushed_elements": self._metrics.flushed_elements,
+                }
+            },
+        )
+        self._journal.rotate()
+        self._metrics.checkpoints += 1
+
+    def _maybe_checkpoint(self) -> None:
+        if self._journal is None or self._flush_seq % self._ckpt_every:
+            return
+        # the barrier runs OUTSIDE the degradation guard: a worker error it
+        # re-raises is a stream failure, not a checkpoint failure
+        self.drain_barrier()
+        try:
+            self._save_snapshot()
+        except Exception as e:
+            # degraded durability, not lost availability: the previous
+            # checkpoint is intact (atomic write) and the journal keeps
+            # growing from it, so recover() still reconstructs everything —
+            # sampling continues
+            if not self._ckpt_failed_logged:
+                self._ckpt_failed_logged = True
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "auto-checkpoint failed (%s: %s); sampling continues, "
+                    "recovery will replay the longer journal (logged once "
+                    "per bridge)",
+                    type(e).__name__,
+                    e,
+                )
+
+    @classmethod
+    def recover(
+        cls,
+        checkpoint_dir: str,
+        map_fn: Optional[Any] = None,
+        hash_fn: Optional[Any] = None,
+        pipelined: Optional[bool] = None,
+        retry_policy: Optional[RetryPolicy] = None,
+        flush_timeout_s: Optional[float] = None,
+        checkpoint_every: Optional[int] = None,
+        faults: Optional[Any] = None,
+    ) -> "DeviceStreamBridge":
+        """Reconstruct a crashed auto-checkpointing bridge from its
+        ``checkpoint_dir`` and replay the journaled post-checkpoint tail.
+
+        The returned bridge's reservoirs are bit-identical to those of an
+        uninterrupted run over the same flushes (counter-keyed draws make
+        replay exact; pinned by ``tests/test_faults.py`` in all three
+        sampling modes).  Resume pushing from :attr:`flushed_seq` /
+        ``metrics.flushed_elements`` — the durable watermark.  ``map_fn``/
+        ``hash_fn`` are code, not data, and must be re-supplied when the
+        bridge was built with them; ``pipelined``/``checkpoint_every``
+        default to the crashed bridge's settings.
+        """
+        from ..utils.checkpoint import load_engine
+
+        engine_path = os.path.join(checkpoint_dir, "engine.npz")
+        engine, metadata = load_engine(
+            engine_path, map_fn=map_fn, hash_fn=hash_fn, with_metadata=True
+        )
+        info = (metadata or {}).get("bridge")
+        if info is None:
+            raise ValueError(
+                f"{engine_path!r} was not written by an auto-checkpointing "
+                "bridge (no bridge metadata); use ReservoirEngine.restore()"
+            )
+        engine._faults = faults
+        bridge = cls(
+            engine.config,
+            map_fn=map_fn,
+            hash_fn=hash_fn,
+            reusable=bool(info["reusable"]),
+            pipelined=bool(info["pipelined"]) if pipelined is None else pipelined,
+            retry_policy=retry_policy,
+            flush_timeout_s=flush_timeout_s,
+            checkpoint_dir=checkpoint_dir,
+            checkpoint_every=(
+                int(info["checkpoint_every"])
+                if checkpoint_every is None
+                else checkpoint_every
+            ),
+            faults=faults,
+            _engine=engine,
+        )
+        covered = int(info["seq"])
+        bridge._flush_seq = covered
+        m = bridge._metrics
+        m.elements = int(info.get("elements", 0))
+        m.flushed_elements = int(info.get("flushed_elements", 0))
+        m.flushes = covered
+        # replay the journaled tail on THIS thread (the pipeline is idle, so
+        # the engine's single-writer contract holds), skipping records the
+        # checkpoint already covers — a crash between checkpoint write and
+        # journal rotation leaves such records behind by design
+        config = engine.config
+        for seq, tile, valid, wtile in _FlushJournal.replay(
+            os.path.join(checkpoint_dir, "journal.bin"),
+            config.num_reservoirs,
+            config.tile_size,
+            np.dtype(config.element_dtype),
+            config.weighted,
+        ):
+            if seq <= covered:
+                continue
+            engine.sample(tile, valid=valid, weights=wtile)
+            total = int(valid.sum())
+            bridge._flush_seq = seq
+            m.flushes += 1
+            m.elements += total
+            m.flushed_elements += total
+        m.recoveries += 1
+        return bridge
 
     # ------------------------------------------------------------ completion
 
@@ -411,6 +926,7 @@ class DeviceStreamBridge:
         self._check_open()
         self.flush()
         self.drain_barrier()  # result() must see every dispatched tile
+        self._metrics.demotions = self._engine.demotions
         with trace_span("reservoir_bridge_result"):
             res = self._engine.result()
         self._metrics.completions += 1
@@ -439,9 +955,19 @@ class DeviceStreamBridge:
     def __del__(self) -> None:
         # postStop backstop (SampleImpl.scala:56-57)
         pipe = getattr(self, "_pipeline", None)
-        if pipe is not None:
-            pipe.close()
         fut = getattr(self, "_future", None)
+        if pipe is not None:
+            try:
+                pipe.close()
+            except BaseException as e:
+                # close() re-raises an error from the FINAL flush (the one
+                # a bare owner-drop used to lose); teardown must not
+                # swallow it — route it through the tri-state protocol
+                if fut is not None and not fut.done():
+                    fut.set_exception(e)
+        journal = getattr(self, "_journal", None)
+        if journal is not None:
+            journal.close()
         if fut is not None and not fut.done():
             fut.set_exception(
                 AbruptStreamTermination(

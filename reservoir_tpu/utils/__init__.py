@@ -1,17 +1,23 @@
-"""Auxiliary subsystems: metrics, tracing, checkpointing (SURVEY §5).
+"""Auxiliary subsystems: metrics, tracing, checkpointing, fault injection
+(SURVEY §5).
 
 The reference has none of these (no logging/metrics dependency, no tracing
-hooks, no checkpointing — SURVEY §5 table); they are mandated additions for
-the TPU framework.  Everything here is dependency-light and optional: the
-core sampling path never requires this package.
+hooks, no checkpointing, no fault injection — SURVEY §5 table); they are
+mandated additions for the TPU framework.  Everything here is
+dependency-light and optional: the core sampling path never requires this
+package, and the fault plane (:mod:`reservoir_tpu.utils.faults`) is a
+zero-overhead no-op unless explicitly installed.
 """
 
 from .checkpoint import load_engine, load_state, save_engine, save_state
+from .faults import FaultPlane, FaultRule
 from .metrics import BridgeMetrics
 from .tracing import trace_span
 
 __all__ = [
     "BridgeMetrics",
+    "FaultPlane",
+    "FaultRule",
     "load_engine",
     "load_state",
     "save_engine",

@@ -13,8 +13,20 @@ produces the *same* reservoirs as an uninterrupted run (pinned by
 Format: a single ``.npz`` holding the state arrays (typed PRNG keys are
 stored as their raw ``key_data`` words plus the impl name) and a JSON
 manifest. Writes are atomic (temp file + ``os.replace``), so a crash during
-checkpointing never corrupts the previous checkpoint — the failure-recovery
-story is "replay from last snapshot" (SURVEY §5 failure-detection row).
+checkpointing never corrupts the previous checkpoint.  This module is the
+storage half of the SURVEY §5 failure-detection row: the *executable*
+"replay from last snapshot" story lives in
+:meth:`reservoir_tpu.stream.bridge.DeviceStreamBridge.recover`, which
+auto-checkpoints through :func:`save_engine` every N flushes, journals the
+post-checkpoint tiles, and replays them bit-exactly after a crash
+(``tests/test_faults.py`` pins the end-to-end guarantee under injected
+faults).  Reads are typed: a truncated/corrupt file raises
+:class:`~reservoir_tpu.errors.CheckpointCorrupt`, a format-version mismatch
+a clear forward-compat ``ValueError`` — recovery tooling never has to catch
+raw numpy/zipfile internals.  The writer carries the ``checkpoint.write``
+fault-injection site (:mod:`reservoir_tpu.utils.faults`), which is how the
+"crash mid-checkpoint leaves the previous checkpoint intact" guarantee is
+exercised in tests.
 
 Self-contained on purpose: no orbax dependency — reservoir state is a
 handful of ``[R, k]`` arrays, not a model tree, and a dependency-free format
@@ -27,9 +39,13 @@ import dataclasses
 import json
 import os
 import tempfile
+import zipfile
 from typing import Any, Callable, Optional, Tuple
 
 import numpy as np
+
+from ..errors import CheckpointCorrupt
+from . import faults
 
 __all__ = ["save_state", "load_state", "save_engine", "load_engine"]
 
@@ -110,6 +126,11 @@ def _atomic_write_npz(path: str, arrays: dict, manifest: dict) -> None:
         os.umask(umask)
         os.chmod(tmp, 0o666 & ~umask)
         with os.fdopen(fd, "wb") as fh:
+            # the injection site fires inside the temp-file guard: a
+            # scheduled "crash" mid-write must leave the previous
+            # checkpoint untouched and no temp litter behind (pinned by
+            # tests/test_faults.py)
+            faults.fire("checkpoint.write")
             np.savez(
                 fh,
                 __manifest__=np.frombuffer(
@@ -135,12 +156,39 @@ def _atomic_write_npz(path: str, arrays: dict, manifest: dict) -> None:
 
 
 def _read_npz(path: str) -> Tuple[dict, dict]:
-    with np.load(path) as data:
-        manifest = json.loads(bytes(data["__manifest__"]).decode())
-        arrays = {k: data[k] for k in data.files if k != "__manifest__"}
-    if manifest.get("format_version") != _FORMAT_VERSION:
+    try:
+        with np.load(path) as data:
+            if "__manifest__" not in data.files:
+                raise CheckpointCorrupt(
+                    f"{path!r} has no checkpoint manifest (not written by "
+                    "save_state/save_engine, or corrupted)"
+                )
+            manifest = json.loads(bytes(data["__manifest__"]).decode())
+            arrays = {k: data[k] for k in data.files if k != "__manifest__"}
+    except FileNotFoundError:
+        raise  # a missing file is an absent checkpoint, not a corrupt one
+    except (zipfile.BadZipFile, EOFError, OSError, KeyError, ValueError) as e:
+        # truncated zip container, truncated member, undecodable manifest —
+        # surface ONE typed error instead of numpy/zipfile internals
+        # (json.JSONDecodeError is a ValueError subclass)
+        if isinstance(e, CheckpointCorrupt):
+            raise
+        raise CheckpointCorrupt(
+            f"checkpoint {path!r} is truncated or corrupt "
+            f"({type(e).__name__}: {e})"
+        ) from e
+    version = manifest.get("format_version")
+    if version != _FORMAT_VERSION:
+        newer = isinstance(version, int) and version > _FORMAT_VERSION
         raise ValueError(
-            f"unsupported checkpoint format {manifest.get('format_version')!r}"
+            f"checkpoint {path!r} has format version {version!r}; this "
+            f"build reads version {_FORMAT_VERSION}"
+            + (
+                " — the file was written by a newer reservoir_tpu; upgrade "
+                "this installation to restore it"
+                if newer
+                else ""
+            )
         )
     return arrays, manifest
 
@@ -206,11 +254,15 @@ def load_engine(
     map_fn: Optional[Callable] = None,
     hash_fn: Optional[Callable] = None,
     engine_cls: Optional[type] = None,
+    *,
+    with_metadata: bool = False,
 ):
     """Reconstruct a checkpointed engine.  Raises if the checkpoint was taken
     with a ``map_fn``/``hash_fn`` and none is supplied (or vice versa) — a
     silent mismatch would quietly change what gets stored.  ``engine_cls``
-    lets ``SubEngine.restore(path)`` come back as the subclass."""
+    lets ``SubEngine.restore(path)`` come back as the subclass.
+    ``with_metadata=True`` returns ``(engine, metadata)`` — the bridge's
+    recovery path reads its journal watermark from there."""
     from ..config import SamplerConfig
     from ..engine import ReservoirEngine
 
@@ -238,4 +290,6 @@ def load_engine(
         _initial_state=_unpack_state(arrays, manifest),
     )
     engine._min_count = info["min_count"]
+    if with_metadata:
+        return engine, manifest["metadata"]
     return engine

@@ -25,6 +25,21 @@ class BridgeMetrics:
     flushed_elements: int = 0
     completions: int = 0
     failures: int = 0
+    # robustness-plane counters (ISSUE 3): transient flush retries executed
+    # by the pipeline worker, watchdog trips (hung-device flushes failed
+    # with FlushTimeout), recoveries (bridges reconstructed via
+    # DeviceStreamBridge.recover), Pallas->XLA demotions observed on the
+    # owning engine, and auto-checkpoints taken.  The worker/watchdog
+    # threads increment retries/watchdog_trips — benign races with snapshot
+    # reads, same telemetry contract as the stage times below.
+    # (init=False like demux_threads: the v0.1.0 released __init__
+    # signature stays stable under the backward-compat gate; owners
+    # increment the counters post-construction)
+    retries: int = dataclasses.field(default=0, init=False)
+    watchdog_trips: int = dataclasses.field(default=0, init=False)
+    recoveries: int = dataclasses.field(default=0, init=False)
+    demotions: int = dataclasses.field(default=0, init=False)
+    checkpoints: int = dataclasses.field(default=0, init=False)
     # per-stage busy time (VERDICT r3 item 5 — the config-5 decomposition):
     # demux = host scatter into the staging tile; drain = fill-count
     # read (+ tile copy in non-zero-copy mode); dispatch = device
@@ -60,6 +75,11 @@ class BridgeMetrics:
             "flushed_elements": self.flushed_elements,
             "completions": self.completions,
             "failures": self.failures,
+            "retries": self.retries,
+            "watchdog_trips": self.watchdog_trips,
+            "recoveries": self.recoveries,
+            "demotions": self.demotions,
+            "checkpoints": self.checkpoints,
             "elapsed_s": elapsed,
             "elements_per_sec": (self.elements / elapsed) if elapsed > 0 else 0.0,
             "stages": {

@@ -155,6 +155,74 @@ def test_restore_preserves_subclass(tmp_path):
     assert isinstance(restored, TaggedEngine) and restored.tag == "custom"
 
 
+def test_truncated_checkpoint_raises_typed_error(tmp_path):
+    # ISSUE 3 satellite: a torn write/partial download must surface as
+    # CheckpointCorrupt, never a raw numpy/zipfile internal
+    from reservoir_tpu.errors import CheckpointCorrupt
+
+    state = al.init(jr.key(0), 2, 2)
+    path = str(tmp_path / "t.npz")
+    save_state(path, state)
+    data = open(path, "rb").read()
+    for cut in (3, len(data) // 2, len(data) - 2):
+        with open(path, "wb") as f:
+            f.write(data[:cut])
+        with pytest.raises(CheckpointCorrupt):
+            load_state(path)
+
+
+def test_garbage_checkpoint_raises_typed_error(tmp_path):
+    from reservoir_tpu.errors import CheckpointCorrupt
+
+    path = str(tmp_path / "g.npz")
+    with open(path, "wb") as f:
+        f.write(b"not a zip archive at all")
+    with pytest.raises(CheckpointCorrupt):
+        load_state(path)
+    with pytest.raises(CheckpointCorrupt):
+        load_engine(path)
+    # a missing file stays FileNotFoundError — absent, not corrupt
+    with pytest.raises(FileNotFoundError):
+        load_state(str(tmp_path / "nope.npz"))
+
+
+def test_npz_without_manifest_raises_typed_error(tmp_path):
+    from reservoir_tpu.errors import CheckpointCorrupt
+
+    path = str(tmp_path / "m.npz")
+    np.savez(path, foo=np.arange(3))
+    with pytest.raises(CheckpointCorrupt, match="manifest"):
+        load_state(path)
+
+
+def test_newer_format_version_gets_forward_compat_error(tmp_path):
+    # ISSUE 3 satellite: a version bump must read as "upgrade to load",
+    # not a generic failure
+    import json
+    import zipfile as _zf
+
+    state = al.init(jr.key(0), 2, 2)
+    path = str(tmp_path / "v.npz")
+    save_state(path, state)
+    # rewrite the embedded manifest with a future format version
+    with np.load(path) as data:
+        manifest = json.loads(bytes(data["__manifest__"]).decode())
+        arrays = {k: data[k] for k in data.files if k != "__manifest__"}
+    manifest["format_version"] = 99
+    with open(path, "wb") as f:
+        np.savez(
+            f,
+            __manifest__=np.frombuffer(
+                json.dumps(manifest).encode(), dtype=np.uint8
+            ),
+            **arrays,
+        )
+    with pytest.raises(ValueError, match="newer reservoir_tpu; upgrade"):
+        load_state(path)
+    with pytest.raises(ValueError, match="format version"):
+        load_engine(path)
+
+
 def test_restore_refuses_dtype_narrowing(tmp_path):
     # int64 counters saved under x64 must not silently narrow to int32 in an
     # x64-off process.

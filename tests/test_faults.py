@@ -1,0 +1,549 @@
+"""Fault matrices: the robustness plane under deterministic injected faults.
+
+Nothing here existed in the reference (SURVEY §5: the tri-state completion
+protocol is its entire failure story, and it was never tested under
+injected faults).  These tests drive every ISSUE-3 guarantee end to end:
+
+- the fault plane itself (schedule determinism, env-spec activation, and
+  zero overhead when disabled);
+- transient flush retries under ``RetryPolicy`` — retried streams complete
+  with results bit-identical to clean runs;
+- retries-exhausted and watchdog failures resolving the materialized
+  future with their cause instead of wedging;
+- crash -> ``DeviceStreamBridge.recover()`` -> bit-exact reservoirs, in all
+  three sampling modes, including a kill mid-stream by an injected fault;
+- checkpoint-write crashes leaving the previous checkpoint intact;
+- runtime Pallas failure -> XLA demotion with sampling continuing.
+"""
+
+from __future__ import annotations
+
+import gc
+import logging
+import os
+
+import numpy as np
+import pytest
+
+from reservoir_tpu import SamplerConfig
+from reservoir_tpu.engine import ReservoirEngine
+from reservoir_tpu.errors import (
+    FlushTimeout,
+    RetryPolicy,
+    SamplerClosedError,
+    TransientDeviceError,
+)
+from reservoir_tpu.stream.bridge import DeviceStreamBridge, _FlushJournal
+from reservoir_tpu.utils import faults
+from reservoir_tpu.utils.faults import FaultPlane, FaultRule
+
+
+@pytest.fixture(autouse=True)
+def _no_global_plane():
+    # every test starts and ends with the plane uninstalled — the disabled
+    # state is the suite-wide default the zero-overhead test pins
+    faults.uninstall()
+    yield
+    faults.uninstall()
+
+
+def _cfg(**kw):
+    kw.setdefault("max_sample_size", 4)
+    kw.setdefault("num_reservoirs", 2)
+    kw.setdefault("tile_size", 8)
+    return SamplerConfig(**kw)
+
+
+# ------------------------------------------------------------- fault plane
+
+
+def test_rule_schedule_after_every_times():
+    plane = FaultPlane([FaultRule("s", exc=ValueError, after=2, every=3, times=2)])
+    fired = []
+    for _ in range(12):
+        try:
+            plane.fire("s")
+            fired.append(False)
+        except ValueError:
+            fired.append(True)
+    # eligible hits are 2, 5, 8, ...; times=2 stops after the second
+    assert [i for i, f in enumerate(fired) if f] == [2, 5]
+    assert plane.hits() == {"s": 12}
+
+
+def test_probabilistic_rule_is_seed_deterministic():
+    def pattern(seed):
+        plane = FaultPlane([FaultRule("s", exc=ValueError, p=0.5)], seed=seed)
+        out = []
+        for _ in range(64):
+            try:
+                plane.fire("s")
+                out.append(False)
+            except ValueError:
+                out.append(True)
+        return out
+
+    a, b = pattern(7), pattern(7)
+    assert a == b  # same seed -> same schedule
+    assert 0 < sum(a) < 64  # and it is actually probabilistic
+    assert pattern(8) != a  # different seed -> different schedule
+
+
+def test_delay_only_rule_sleeps_but_does_not_raise():
+    plane = FaultPlane([FaultRule("s", exc=None, delay=0.01, times=1)])
+    plane.fire("s")  # must not raise
+    plane.fire("s")
+    assert plane.hits() == {"s": 2}
+
+
+def test_spec_parsing_round_trip():
+    plane = faults.from_spec(
+        "seed=9; bridge.dispatch:exc=TransientDeviceError,times=2,after=1;"
+        "checkpoint.write:exc=OSError;engine.update:exc=none,delay=0.0"
+    )
+    rules = plane._rules
+    assert set(rules) == {"bridge.dispatch", "checkpoint.write", "engine.update"}
+    r = rules["bridge.dispatch"][0]
+    assert r.exc is TransientDeviceError and r.times == 2 and r.after == 1
+    assert rules["engine.update"][0].exc is None
+    with pytest.raises(ValueError, match="unknown exception"):
+        faults.from_spec("s:exc=NoSuchError")
+    with pytest.raises(ValueError, match="unknown rule key"):
+        faults.from_spec("s:bogus=1")
+
+
+def test_env_spec_activation(monkeypatch):
+    monkeypatch.setenv(
+        "RESERVOIR_FAULTS", "bridge.demux:exc=TransientDeviceError,times=1"
+    )
+    plane = faults.install_from_env()
+    assert plane is faults._PLANE
+    bridge = DeviceStreamBridge(_cfg(), key=1)
+    with pytest.raises(TransientDeviceError):
+        bridge.push(0, 1)
+    bridge.push(0, 2)  # times=1: exhausted, stream continues
+    monkeypatch.delenv("RESERVOIR_FAULTS")
+    assert faults.install_from_env() is None
+    assert faults._PLANE is None
+
+
+def test_disabled_plane_is_zero_overhead_noop(monkeypatch):
+    # the disabled fast path must never reach FaultPlane.fire at all: with
+    # no plane installed, a trip-wired fire() proves every site short-
+    # circuits on the module-global None check (and state/counters are
+    # untouched because none exist to touch)
+    assert faults._PLANE is None
+
+    def tripwire(self, site):  # pragma: no cover - would fail the test
+        raise AssertionError(f"site {site} fired with the plane disabled")
+
+    monkeypatch.setattr(FaultPlane, "fire", tripwire)
+    assert faults.fire("bridge.dispatch") is None
+    # a full bridge stream crosses demux, staging, dispatch, engine.update
+    bridge = DeviceStreamBridge(_cfg(), key=2)
+    bridge.push(0, np.arange(32, dtype=np.int32))
+    bridge.complete()
+    # and the checkpoint writer's site is a no-op too
+    eng = ReservoirEngine(_cfg(), key=0, reusable=True)
+    eng.sample(np.arange(16, dtype=np.int32).reshape(2, 8))
+
+
+def test_all_sites_exercised(tmp_path):
+    # a rule-free global plane counts hits without raising: one bridge
+    # stream with auto-checkpointing must cross every site of ISSUE 3
+    with faults.active(FaultPlane()) as plane:
+        bridge = DeviceStreamBridge(
+            _cfg(),
+            key=3,
+            checkpoint_dir=str(tmp_path / "ck"),
+            checkpoint_every=2,
+        )
+        bridge.push(0, np.arange(32, dtype=np.int32))
+        bridge.push_interleaved(
+            np.zeros(8, np.int32), np.arange(8, dtype=np.int32)
+        )
+        bridge.complete()
+        # engine.pallas fires only on the Pallas dispatch branch
+        eng = ReservoirEngine(_cfg(impl="pallas"), key=0, reusable=True)
+        eng.sample(np.arange(16, dtype=np.int32).reshape(2, 8))
+        hits = plane.hits()
+    for site in faults.SITES:
+        assert hits.get(site, 0) >= 1, (site, hits)
+
+
+# ------------------------------------------------------- retry and watchdog
+
+
+def test_transient_retry_then_success_bit_identical():
+    data = np.arange(40, dtype=np.int32)
+    plane = FaultPlane(
+        [FaultRule("bridge.dispatch", exc=TransientDeviceError, times=2)]
+    )
+    faulty = DeviceStreamBridge(
+        _cfg(),
+        key=3,
+        faults=plane,
+        retry_policy=RetryPolicy(max_retries=3, base_backoff_s=0.001),
+    )
+    clean = DeviceStreamBridge(_cfg(), key=3)
+    faulty.push(0, data)
+    clean.push(0, data)
+    res_f, res_c = faulty.complete(), clean.complete()
+    assert faulty.metrics.retries == 2
+    assert faulty.metrics.failures == 0
+    for a, b in zip(res_f, res_c):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_retries_exhausted_fails_stream_with_cause():
+    plane = FaultPlane([FaultRule("bridge.dispatch", exc=TransientDeviceError)])
+    bridge = DeviceStreamBridge(
+        _cfg(),
+        key=4,
+        faults=plane,
+        retry_policy=RetryPolicy(max_retries=2, base_backoff_s=0.001),
+    )
+    bridge.push(0, np.arange(8, dtype=np.int32))  # fills a row -> flush
+    with pytest.raises(TransientDeviceError):
+        bridge.drain_barrier()
+    # the future resolved with the cause through the tri-state protocol
+    assert isinstance(bridge.sample.exception(timeout=2), TransientDeviceError)
+    assert bridge.metrics.retries == 2
+    assert bridge.metrics.failures == 1
+    with pytest.raises(SamplerClosedError):
+        bridge.push(0, 1)
+
+
+def test_fatal_error_not_retried():
+    plane = FaultPlane(
+        [FaultRule("bridge.dispatch", exc=RuntimeError, message="fatal")]
+    )
+    bridge = DeviceStreamBridge(
+        _cfg(),
+        key=5,
+        faults=plane,
+        retry_policy=RetryPolicy(max_retries=5, base_backoff_s=0.001),
+    )
+    bridge.push(0, np.arange(8, dtype=np.int32))
+    assert isinstance(bridge.sample.exception(timeout=2), RuntimeError)
+    assert bridge.metrics.retries == 0  # fatal taxonomy: no retry burned
+
+
+def test_watchdog_trips_on_hung_flush():
+    # a simulated hung device (delay-only rule) must fail the future with
+    # FlushTimeout instead of wedging complete()/result() forever
+    plane = FaultPlane([FaultRule("bridge.dispatch", exc=None, delay=0.5)])
+    bridge = DeviceStreamBridge(
+        _cfg(), key=6, faults=plane, flush_timeout_s=0.05
+    )
+    bridge.push(0, np.arange(8, dtype=np.int32))
+    exc = bridge.sample.exception(timeout=2)
+    assert isinstance(exc, FlushTimeout)
+    assert bridge.metrics.watchdog_trips == 1
+    # the pipeline is wedged, not silently unusable: joins raise
+    with pytest.raises(FlushTimeout):
+        bridge.drain_barrier()
+    with pytest.raises(SamplerClosedError):
+        bridge.push(0, 1)
+    # let the delayed worker drain so teardown is clean
+    import time
+
+    time.sleep(0.6)
+
+
+# --------------------------------------------------- checkpoint + recovery
+
+
+def _mode_cfg(mode, **kw):
+    return _cfg(
+        num_reservoirs=3,
+        distinct=(mode == "distinct"),
+        weighted=(mode == "weighted"),
+        **kw,
+    )
+
+
+def _push_round(bridge, data, wdata, r, s, B):
+    chunk = data[s][r * B : (r + 1) * B]
+    if wdata is not None:
+        bridge.push(s, chunk, weights=wdata[s][r * B : (r + 1) * B])
+    else:
+        bridge.push(s, chunk)
+
+
+def _make_feed(mode, S, B, rounds, seed=0):
+    rng = np.random.default_rng(seed)
+    data = {
+        s: rng.integers(0, 1 << 30, rounds * B).astype(np.int32)
+        for s in range(S)
+    }
+    if mode == "distinct":
+        # duplicates across the stream exercise the bottom-k collapse
+        for s in range(S):
+            data[s] = (data[s] % 97).astype(np.int32)
+    wdata = (
+        {s: rng.uniform(0.1, 2.0, rounds * B).astype(np.float32) for s in range(S)}
+        if mode == "weighted"
+        else None
+    )
+    return data, wdata
+
+
+@pytest.mark.parametrize("mode", ["plain", "weighted", "distinct"])
+def test_recovery_bit_exact_after_crash(tmp_path, mode):
+    """Crash after flush F -> recover() -> continue == uninterrupted run."""
+    S, B, rounds, crash_round = 3, 8, 6, 4
+    data, wdata = _make_feed(mode, S, B, rounds)
+
+    ref = DeviceStreamBridge(_mode_cfg(mode), key=7)
+    for r in range(rounds):
+        for s in range(S):
+            _push_round(ref, data, wdata, r, s, B)
+    expected = ref.complete()
+
+    ckdir = str(tmp_path / "ck")
+    bridge = DeviceStreamBridge(
+        _mode_cfg(mode), key=7, checkpoint_dir=ckdir, checkpoint_every=5
+    )
+    for r in range(crash_round):
+        for s in range(S):
+            _push_round(bridge, data, wdata, r, s, B)
+    bridge.drain_barrier()
+    assert bridge.flushed_seq == crash_round * S
+    del bridge  # the crash: no complete(), no clean shutdown
+    gc.collect()
+
+    recovered = DeviceStreamBridge.recover(ckdir)
+    assert recovered.metrics.recoveries == 1
+    assert recovered.flushed_seq == crash_round * S
+    for r in range(crash_round, rounds):
+        for s in range(S):
+            _push_round(recovered, data, wdata, r, s, B)
+    got = recovered.complete()
+    for a, b in zip(expected, got):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+@pytest.mark.parametrize("mode", ["plain", "weighted", "distinct"])
+def test_recovery_rehearsal_kill_mid_stream_under_injected_fault(
+    tmp_path, mode
+):
+    """The acceptance flow: auto-checkpoint, kill the bridge mid-stream
+    with an injected fatal dispatch fault, recover from the durable
+    watermark, finish the feed — reservoirs bit-identical to an
+    uninterrupted run (this is also what the watcher's
+    ``recovery_rehearsal`` post-step executes on hardware windows)."""
+    S, B, rounds = 3, 8, 8
+    data, wdata = _make_feed(mode, S, B, rounds, seed=1)
+
+    ref = DeviceStreamBridge(_mode_cfg(mode), key=11)
+    for r in range(rounds):
+        for s in range(S):
+            _push_round(ref, data, wdata, r, s, B)
+    expected = ref.complete()
+
+    ckdir = str(tmp_path / "ck")
+    plane = FaultPlane(
+        [FaultRule("bridge.dispatch", exc=RuntimeError, after=13, times=1,
+                   message="injected kill")]
+    )
+    bridge = DeviceStreamBridge(
+        _mode_cfg(mode),
+        key=11,
+        checkpoint_dir=ckdir,
+        checkpoint_every=4,
+        faults=plane,
+    )
+    killed = False
+    try:
+        for r in range(rounds):
+            for s in range(S):
+                _push_round(bridge, data, wdata, r, s, B)
+        bridge.complete()
+    except (RuntimeError, SamplerClosedError):
+        killed = True
+    assert killed, "the injected fault must kill the stream mid-feed"
+    assert isinstance(bridge.sample.exception(timeout=2), RuntimeError)
+    del bridge
+    gc.collect()
+
+    recovered = DeviceStreamBridge.recover(ckdir, faults=None)
+    # every journaled flush survives — including the one whose dispatch
+    # failed (journaled before submission); resume from the watermark
+    covered = recovered.flushed_seq
+    assert covered >= 13  # the failed flush itself is durable
+    for seq in range(covered, rounds * S):
+        r, s = divmod(seq, S)
+        _push_round(recovered, data, wdata, r, s, B)
+    got = recovered.complete()
+    for a, b in zip(expected, got):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_checkpoint_write_crash_leaves_previous_checkpoint_intact(tmp_path):
+    cfg = _cfg()
+    eng = ReservoirEngine(cfg, key=0, reusable=True)
+    tile = np.arange(16, dtype=np.int32).reshape(2, 8)
+    eng.sample(tile)
+    path = tmp_path / "e.npz"
+    eng.save(str(path))
+    before = path.read_bytes()
+    eng.sample(tile + 100)
+    with faults.active(
+        FaultPlane([FaultRule("checkpoint.write", exc=OSError, times=1)])
+    ):
+        with pytest.raises(OSError):
+            eng.save(str(path))
+    # previous checkpoint byte-identical, no temp litter
+    assert path.read_bytes() == before
+    assert sorted(os.listdir(tmp_path)) == ["e.npz"]
+    restored = ReservoirEngine.restore(str(path))
+    assert restored.config == cfg
+
+
+def test_auto_checkpoint_failure_degrades_durability_not_availability(
+    tmp_path, caplog
+):
+    # a failing periodic checkpoint write is logged once and sampling
+    # continues on the longer journal; recovery stays bit-exact because
+    # the seq-0 anchor + full journal reconstruct everything
+    S, B, rounds = 2, 8, 6
+    data, _ = _make_feed("plain", S, B, rounds, seed=2)
+    ref = DeviceStreamBridge(_cfg(), key=9)
+    for r in range(rounds):
+        for s in range(S):
+            _push_round(ref, data, None, r, s, B)
+    expected = ref.complete()
+
+    ckdir = str(tmp_path / "ck")
+    # after=1 skips the construction-time seq-0 anchor; every periodic
+    # write then fails
+    plane = FaultPlane(
+        [FaultRule("checkpoint.write", exc=OSError, after=1)]
+    )
+    with faults.active(plane):  # checkpoint.write is a global-plane site
+        bridge = DeviceStreamBridge(
+            _cfg(), key=9, checkpoint_dir=ckdir, checkpoint_every=3
+        )
+        with caplog.at_level(logging.WARNING, "reservoir_tpu.stream.bridge"):
+            for r in range(4):
+                for s in range(S):
+                    _push_round(bridge, data, None, r, s, B)
+        bridge.drain_barrier()
+        assert bridge.metrics.checkpoints == 1  # only the seq-0 anchor
+        warnings = [
+            rec for rec in caplog.records if "auto-checkpoint failed" in rec.message
+        ]
+        assert len(warnings) == 1  # logged once, not once per failure
+        del bridge
+        gc.collect()
+
+    recovered = DeviceStreamBridge.recover(ckdir)
+    assert recovered.flushed_seq == 4 * S  # the journal carried everything
+    for r in range(4, rounds):
+        for s in range(S):
+            _push_round(recovered, data, None, r, s, B)
+    got = recovered.complete()
+    for a, b in zip(expected, got):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_journal_tolerates_truncated_and_corrupt_tail(tmp_path):
+    path = str(tmp_path / "journal.bin")
+    S, B = 2, 4
+    journal = _FlushJournal(path, S, B, np.int32, weighted=False)
+    tiles = []
+    for seq in range(1, 4):
+        tile = np.full((S, B), seq, np.int32)
+        valid = np.full(S, B, np.int32)
+        journal.append(seq, tile, valid, None)
+        tiles.append(tile)
+    journal.close()
+
+    full = os.path.getsize(path)
+    # truncate mid-last-record: replay yields exactly the intact prefix
+    with open(path, "r+b") as fh:
+        fh.truncate(full - 7)
+    recs = list(_FlushJournal.replay(path, S, B, np.int32, False))
+    assert [r[0] for r in recs] == [1, 2]
+    np.testing.assert_array_equal(recs[1][1], tiles[1])
+
+    # corrupt a payload byte inside record 2 (the last intact one): the
+    # CRC mismatch stops replay after record 1
+    record_bytes = full // 3
+    with open(path, "r+b") as fh:
+        off = record_bytes + _FlushJournal._HEADER.size + 5
+        fh.seek(off)
+        b = fh.read(1)
+        fh.seek(off)
+        fh.write(bytes([b[0] ^ 0xFF]))
+    recs = list(_FlushJournal.replay(path, S, B, np.int32, False))
+    assert [r[0] for r in recs] == [1]
+
+
+def test_recover_rejects_plain_engine_checkpoint(tmp_path):
+    eng = ReservoirEngine(_cfg(), key=0, reusable=True)
+    eng.sample(np.arange(16, dtype=np.int32).reshape(2, 8))
+    d = tmp_path / "ck"
+    d.mkdir()
+    eng.save(str(d / "engine.npz"))
+    with pytest.raises(ValueError, match="auto-checkpointing bridge"):
+        DeviceStreamBridge.recover(str(d))
+
+
+# -------------------------------------------------------- Pallas demotion
+
+
+def test_pallas_failure_demotes_to_xla_and_continues(caplog):
+    cfg = _cfg(impl="pallas")
+    plane = FaultPlane(
+        [FaultRule("engine.pallas", exc=RuntimeError, times=1,
+                   message="mosaic boom")]
+    )
+    eng = ReservoirEngine(cfg, key=5, faults=plane, reusable=True)
+    ref = ReservoirEngine(_cfg(impl="xla"), key=5, reusable=True)
+    tile = np.arange(16, dtype=np.int32).reshape(2, 8)
+    with caplog.at_level(logging.WARNING, "reservoir_tpu.engine"):
+        eng.sample(tile)
+    ref.sample(tile)
+    assert eng.demotions == 1
+    assert eng.xla_used()
+    assert sum(
+        "demoted to the XLA path" in rec.message for rec in caplog.records
+    ) == 1
+    # sampling continues, bit-identical to a pure-XLA engine
+    eng.sample(tile + 100)
+    ref.sample(tile + 100)
+    np.testing.assert_array_equal(
+        eng.result_arrays()[0], ref.result_arrays()[0]
+    )
+    # demoted engines never route back to Pallas: the dispatch gate now
+    # reports the demotion as the fallback reason for every tile shape
+    assert "demoted" in eng._pallas_fallback_reason(True, False, np.int32)
+
+
+def test_demotion_surfaces_on_bridge_metrics():
+    plane = FaultPlane(
+        [FaultRule("engine.pallas", exc=RuntimeError, times=1)]
+    )
+    bridge = DeviceStreamBridge(_cfg(impl="pallas"), key=6, faults=plane)
+    # push_tile without valid is the bridge path that can reach Pallas
+    bridge.push_tile(np.arange(16, dtype=np.int32).reshape(2, 8))
+    assert bridge.metrics.demotions == 1
+    res = bridge.complete()
+    assert len(res) == 2
+
+
+def test_fused_stream_demotes_too():
+    plane = FaultPlane(
+        [FaultRule("engine.pallas", exc=RuntimeError, times=1)]
+    )
+    eng = ReservoirEngine(_cfg(impl="pallas"), key=8, faults=plane, reusable=True)
+    ref = ReservoirEngine(_cfg(impl="xla"), key=8, reusable=True)
+    stream = np.arange(2 * 64, dtype=np.int32).reshape(2, 64)
+    eng.sample_stream(stream, fused=True)
+    ref.sample_stream(stream, fused=True)
+    assert eng.demotions == 1
+    np.testing.assert_array_equal(
+        eng.result_arrays()[0], ref.result_arrays()[0]
+    )

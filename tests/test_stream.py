@@ -385,6 +385,43 @@ def test_bridge_pipelined_worker_error_surfaces():
         bridge.drain_barrier()
 
 
+def test_bridge_close_reraises_final_flush_error():
+    # regression (ISSUE 3 satellite): an exception raised on the FINAL
+    # flush after the last join() used to be silently lost when the owner
+    # closed without another reserve()/join().  close() must re-raise it,
+    # and the worker must have routed it to the future already.
+    cfg = SamplerConfig(max_sample_size=4, num_reservoirs=2, tile_size=4)
+    bridge = DeviceStreamBridge(cfg, key=16)
+
+    def _boom(*a):
+        raise RuntimeError("final flush boom")
+
+    bridge._pipeline._fn = lambda: _boom  # mimics WeakMethod resolution
+    bridge.push(0, np.arange(4, dtype=np.int32))  # fills row -> flush
+    # the error reaches the materialized future without any further call
+    assert isinstance(bridge.sample.exception(timeout=2), RuntimeError)
+    with pytest.raises(RuntimeError, match="final flush boom"):
+        bridge._pipeline.close()
+
+
+def test_bridge_drop_after_final_flush_error_fails_future_with_cause():
+    # the owner-drop variant of the same regression: __del__ must not let
+    # the abrupt-termination backstop mask the real cause
+    cfg = SamplerConfig(max_sample_size=4, num_reservoirs=2, tile_size=4)
+    bridge = DeviceStreamBridge(cfg, key=17)
+
+    def _boom(*a):
+        raise RuntimeError("lost on close")
+
+    bridge._pipeline._fn = lambda: _boom
+    bridge.push(0, np.arange(4, dtype=np.int32))
+    fut = bridge.sample
+    del bridge
+    gc.collect()
+    exc = fut.exception(timeout=2)
+    assert isinstance(exc, RuntimeError) and "lost on close" in str(exc)
+
+
 def test_bridge_failure_protocol():
     cfg = SamplerConfig(max_sample_size=4, num_reservoirs=2, tile_size=8)
     bridge = DeviceStreamBridge(cfg, key=8)

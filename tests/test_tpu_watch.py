@@ -348,6 +348,61 @@ def test_post_steps_include_kernel_sweeps():
     assert order.index("distinct_sweep") < order.index("algl_best_block")
 
 
+def test_recovery_rehearsal_post_step_registered():
+    # the ISSUE-3 robustness post-step: budget-capped, runs the crash/
+    # recover/bit-equality suite against the live backend, LAST in the
+    # queue so perf evidence (sweeps, best-block) is never starved by it
+    steps = {name: (cmd, timeout, env) for name, cmd, timeout, env in
+             tpu_watch.POST_STEPS}
+    cmd, timeout, env = steps["recovery_rehearsal"]
+    assert "tests/test_faults.py" in cmd
+    assert "-k" in cmd and "recovery or rehearsal" in cmd
+    assert 0 < timeout <= 900
+    assert env.get("RESERVOIR_TPU_TEST_PLATFORM") == "native"
+    assert [name for name, *_ in tpu_watch.POST_STEPS][-1] == (
+        "recovery_rehearsal"
+    )
+
+
+def test_capture_surfaces_fault_counters(tmp_path, monkeypatch):
+    # a bridge evidence row carrying robustness counters must lift them to
+    # the capture row's top level, like the tuned geometry
+    monkeypatch.setattr(tpu_watch, "REPO", str(tmp_path))
+    monkeypatch.setattr(
+        tpu_watch, "CAPTURE", str(tmp_path / "TPU_CAPTURE_r95.jsonl")
+    )
+
+    class _Proc:
+        returncode = 0
+        stderr = ""
+        stdout = json.dumps(
+            {
+                "metric": "bridge_host_feed_elements_per_sec",
+                "value": 1e9,
+                "platform": "tpu",
+                "stages": {
+                    "demux_s": 1.0,
+                    "faults": {"retries": 2, "watchdog_trips": 0,
+                               "recoveries": 0, "demotions": 1,
+                               "checkpoints": 0},
+                },
+            }
+        ) + "\n"
+
+    monkeypatch.setattr(
+        tpu_watch.subprocess, "run", lambda *a, **k: _Proc()
+    )
+    assert tpu_watch.capture_bench("bridge") == "ok"
+    rows = [
+        json.loads(line)
+        for line in open(tmp_path / "TPU_CAPTURE_r95.jsonl")
+    ]
+    assert rows[-1]["fault_counters"] == {
+        "retries": 2, "watchdog_trips": 0, "recoveries": 0,
+        "demotions": 1, "checkpoints": 0,
+    }
+
+
 def test_post_step_rehearsal_sequential_gating(tmp_path, monkeypatch):
     # drive run_post_steps end-to-end against simulated children: the
     # kernel sweeps run in order; a failure (distinct_sweep here) keeps
@@ -382,7 +437,8 @@ def test_post_step_rehearsal_sequential_gating(tmp_path, monkeypatch):
     # carries over together with everything gated behind it
     assert any("--kernel weighted" in r for r in ran)
     assert [s[0] for s in remaining] == [
-        "distinct_sweep", "pallas_device_tests", "algl_best_block"
+        "distinct_sweep", "pallas_device_tests", "algl_best_block",
+        "recovery_rehearsal",
     ]
     assert committed == ["2 post-step(s) recorded"]
     rows = [

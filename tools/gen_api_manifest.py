@@ -66,6 +66,7 @@ PUBLIC_MODULES = [
     "reservoir_tpu.stream.interop",
     "reservoir_tpu.stream.operator",
     "reservoir_tpu.utils.checkpoint",
+    "reservoir_tpu.utils.faults",
     "reservoir_tpu.utils.metrics",
     "reservoir_tpu.utils.selftest",
     "reservoir_tpu.utils.tracing",

@@ -223,6 +223,13 @@ def capture_bench(
         # top level: evidence rows must say which kernel geometry produced
         # the number without digging through the bench JSON
         rec["geometry"] = parsed["geometry"]
+    if isinstance(parsed, dict) and isinstance(
+        parsed.get("stages", {}).get("faults"), dict
+    ):
+        # likewise the robustness counters (retries/watchdog_trips/
+        # recoveries/demotions): a bridge row earned through retries or a
+        # demoted kernel must say so at the row's top level
+        rec["fault_counters"] = parsed["stages"]["faults"]
     _append(rec)
     if proc.returncode != 0 or parsed is None:
         if "backend unreachable" in proc.stderr:
@@ -367,6 +374,26 @@ POST_STEPS: list[tuple[str, list[str], float, dict]] = [
         [sys.executable, os.path.join(REPO, "tools", "tpu_best_block.py")],
         2700.0,
         {},
+    ),
+    (
+        # robustness rehearsal (ISSUE 3): auto-checkpoint, kill the bridge
+        # mid-stream under an injected dispatch fault, recover() and assert
+        # bit-equality with an uninterrupted run — the recovery story
+        # exercised against the real backend, budget-capped like every
+        # other post-step
+        "recovery_rehearsal",
+        [
+            sys.executable,
+            "-m",
+            "pytest",
+            "tests/test_faults.py",
+            "-q",
+            "--no-header",
+            "-k",
+            "recovery or rehearsal",
+        ],
+        600.0,
+        {"RESERVOIR_TPU_TEST_PLATFORM": "native"},
     ),
 ]
 
