@@ -30,8 +30,12 @@ from .errors import (
     FlushTimeout,
     RetryPolicy,
     SamplerClosedError,
+    ServiceSaturated,
+    SessionIngestError,
+    StaleSessionError,
     StreamCancelled,
     TransientDeviceError,
+    UnknownSessionError,
 )
 
 __version__ = "0.1.0"
@@ -52,6 +56,10 @@ def __getattr__(name):
         from . import stream
 
         return getattr(stream, name)
+    if name in ("ReservoirService", "SessionTable", "Session"):
+        from . import serve
+
+        return getattr(serve, name)
     raise AttributeError(f"module 'reservoir_tpu' has no attribute {name!r}")
 
 
@@ -66,6 +74,10 @@ __all__ = [
     "FlushTimeout",
     "CheckpointCorrupt",
     "RetryPolicy",
+    "UnknownSessionError",
+    "StaleSessionError",
+    "SessionIngestError",
+    "ServiceSaturated",
     "Sampler",
     "sampler",
     "distinct",
@@ -73,5 +85,8 @@ __all__ = [
     "Sample",
     "DeviceStreamBridge",
     "DeviceSampler",
+    "ReservoirService",
+    "SessionTable",
+    "Session",
     "__version__",
 ]

@@ -208,6 +208,10 @@ class ReservoirEngine:
         # fill vs steady dispatch with no device readback.
         self._min_count = 0
         self._jit_cache: dict = {}
+        # reset_rows scatter jits, keyed by batch size (separate from
+        # _jit_cache: its keys carry the Pallas-dispatch layout that
+        # pallas_used()/xla_used() introspect positionally)
+        self._reset_jit: dict = {}
         # jit-cache key -> autotuned Geometry (or None = kernel defaults);
         # observability for tests and the capture tooling
         self._geometry_by_key: dict = {}
@@ -710,17 +714,24 @@ class ReservoirEngine:
 
         Unweighted engines take ``tile`` or ``(tile, valid)`` items; weighted
         engines take ``(tile, weights)`` or ``(tile, weights, valid)``.
+        A shape/dtype error names the offending item index — at tens of
+        thousands of streams "tile must be [R, B]" alone is undebuggable.
         """
         self._check_open()
-        for item in tiles:
-            if not isinstance(item, tuple):
-                self.sample(item)
-            elif self._config.weighted:
-                tile, weights = item[0], item[1]
-                valid = item[2] if len(item) > 2 else None
-                self.sample(tile, valid=valid, weights=weights)
-            else:
-                self.sample(item[0], valid=item[1] if len(item) > 1 else None)
+        for i, item in enumerate(tiles):
+            try:
+                if not isinstance(item, tuple):
+                    self.sample(item)
+                elif self._config.weighted:
+                    tile, weights = item[0], item[1]
+                    valid = item[2] if len(item) > 2 else None
+                    self.sample(tile, valid=valid, weights=weights)
+                else:
+                    self.sample(
+                        item[0], valid=item[1] if len(item) > 1 else None
+                    )
+            except (TypeError, ValueError) as e:
+                raise type(e)(f"tiles[{i}]: {e}") from None
 
     def sample_stream(
         self,
@@ -910,6 +921,76 @@ class ReservoirEngine:
             )
         self._min_count += n_full * B
 
+    # ------------------------------------------------------------ row leasing
+
+    def reset_rows(self, rows: Any, key: Union[int, jax.Array]) -> None:
+        """Re-initialize the given reservoir rows in place to empty state
+        with fresh randomness derived from ``key`` — the session-recycling
+        primitive of the serving plane (:mod:`reservoir_tpu.serve`).
+
+        The engine is NOT reseeded: only the named rows are rebuilt, by
+        scattering a freshly ``init``-ed sub-state over them, so every
+        other row's stream continues bit-identically.  Callers derive
+        ``key`` per ``(row, generation)`` with counter-keyed Threefry
+        fold-ins (``SessionTable.sub_key``), which makes a recycled row
+        statistically fresh AND the reset deterministic — replayable after
+        :meth:`~reservoir_tpu.stream.bridge.DeviceStreamBridge.recover`.
+
+        Single-writer contract as :meth:`sample`: callers using a pipelined
+        bridge must drain it first.  Resets drop the host-side fill lower
+        bound to 0, so later duplicates-mode tiles re-take the fill-capable
+        path (a device-side no-op for rows already full).
+        """
+        self._check_open()
+        rows = np.asarray(rows, np.int32)
+        if rows.ndim != 1 or rows.size == 0:
+            raise ValueError(f"rows must be a non-empty 1-D index array, got shape {rows.shape}")
+        R = self._config.num_reservoirs
+        if int(rows.min()) < 0 or int(rows.max()) >= R:
+            bad = int(rows[np.argmax((rows < 0) | (rows >= R))])
+            raise ValueError(f"row {bad} out of range [0, {R})")
+        if isinstance(key, int):
+            key = jr.key(key)
+        fn = self._reset_jit.get(rows.size)
+        if fn is None:
+            # ONE jitted dispatch per reset batch: sub-state init fused
+            # with the scatter (an eager init costs ~100ms of per-op
+            # dispatch; session churn makes this a serving hot path)
+            n = int(rows.size)
+            k = self._config.max_sample_size
+            sample_dtype = jnp.dtype(self._config.resolved_sample_dtype())
+            count_dtype = (
+                self._config.count_dtype
+                if self._config.count_dtype == "wide"
+                else jnp.dtype(self._config.count_dtype)
+            )
+            ops = self._ops
+
+            def reset(state, reset_key, idx):
+                part = ops.init(
+                    reset_key, n, k,
+                    sample_dtype=sample_dtype, count_dtype=count_dtype,
+                )
+                return jax.tree.map(
+                    lambda full, one: full.at[idx].set(one), state, part
+                )
+
+            fn = jax.jit(reset, donate_argnums=(0,))
+            self._reset_jit[rows.size] = fn
+        idx = rows
+        if self._mesh is not None:
+            idx = jax.device_put(rows)  # scatter indices are replicated
+        self._state = fn(self._state, key, idx)
+        if self._mesh is not None:
+            from .parallel import shard_state
+
+            # the scatter may have loosened the reservoir-axis sharding;
+            # re-pin it so later updates stay collective-free SPMD
+            self._state = shard_state(
+                self._state, self._mesh, self._config.mesh_axis
+            )
+        self._min_count = 0
+
     # ----------------------------------------------------------- checkpoints
 
     def save(self, path: str, metadata: Optional[dict] = None) -> None:
@@ -955,7 +1036,31 @@ class ReservoirEngine:
             self._open = False
             self._state = None  # free device buffers
             self._jit_cache.clear()
+            self._reset_jit.clear()
         return out
+
+    def peek_arrays(self) -> Tuple[np.ndarray, np.ndarray]:
+        """Non-destructive :meth:`result_arrays`: the same device->host
+        ``(samples [R, k], sizes [R])`` with the same truncation contract,
+        but the engine stays open — single-use or not — and keeps
+        streaming.  This is the serving plane's live snapshot path
+        (:mod:`reservoir_tpu.serve`): results are readable while streams
+        are still open, without spending the single-use lifecycle.
+
+        Safe against the donation fast path because the host copy is taken
+        before any later update can consume the state buffers; callers
+        sharing the engine with a pipelined bridge must drain it first
+        (the engine's single-writer contract)."""
+        self._check_open()
+        state = self._state
+        samples, sizes = self._ops.result(state)
+        if self._wide:
+            samples = _distinct.assemble_values(
+                samples,
+                state.value_hi,
+                np.dtype(self._config.resolved_sample_dtype()),
+            )
+        return np.asarray(samples), np.asarray(sizes)
 
     def result(self) -> List[np.ndarray]:
         """Per-reservoir samples, truncated to their fill level."""

@@ -74,6 +74,42 @@ class CheckpointCorrupt(RuntimeError):
     raw numpy/zipfile internals."""
 
 
+class UnknownSessionError(KeyError):
+    """A session key is not (or no longer) leased in the serving plane's
+    :class:`~reservoir_tpu.serve.sessions.SessionTable` — never opened,
+    closed, or evicted (TTL/LRU).  ``KeyError`` subclass: the table is a
+    mapping and callers may already handle lookup misses that way."""
+
+
+class StaleSessionError(RuntimeError):
+    """A session handle references a recycled reservoir row: the row's
+    generation counter moved past the handle's lease.  Raised instead of
+    serving another tenant's data — the serve plane's equivalent of a
+    use-after-free guard."""
+
+
+class SessionIngestError(RuntimeError):
+    """An ingest for one session failed (device dispatch error, injected
+    ``serve.ingest`` fault, bad payload).  Scoped to the failing call: the
+    service and every other session stay live.  ``session`` names the key."""
+
+    def __init__(self, session, message: str) -> None:
+        super().__init__(f"session {session!r}: {message}")
+        self.session = session
+
+
+class ServiceSaturated(RuntimeError):
+    """Admission control verdict: the serving plane's in-flight byte bound
+    is exceeded and the flush pipeline cannot absorb more right now.
+    Retry after ``retry_after_s`` — the request was REJECTED, not queued
+    (bounded memory is the contract; queuing unboundedly would trade an
+    explicit 429 for an OOM)."""
+
+    def __init__(self, message: str, retry_after_s: float) -> None:
+        super().__init__(message)
+        self.retry_after_s = retry_after_s
+
+
 @dataclasses.dataclass(frozen=True)
 class RetryPolicy:
     """Bounded, jittered exponential backoff for *transient* flush failures.

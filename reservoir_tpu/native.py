@@ -378,7 +378,13 @@ class NativeStaging:
         if streams.size and (
             int(streams.min()) < 0 or int(streams.max()) >= self._S
         ):
-            raise ValueError("stream id out of range")
+            # name the offending pair: "out of range" alone is unusable in
+            # a 65k-stream interleaved feed
+            bad = int(np.argmax((streams < 0) | (streams >= self._S)))
+            raise ValueError(
+                f"stream id {int(streams[bad])} out of range [0, {self._S}) "
+                f"at position {bad} of the interleaved batch"
+            )
         if self._lib is not None:
             took = self._lib.rsv_staging_push_interleaved(
                 self._handle,

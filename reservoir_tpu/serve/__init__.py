@@ -1,0 +1,25 @@
+"""Multi-tenant serving plane over the engine/bridge stack (SURVEY §7.3's
+"millions of users" row made executable).
+
+Everything below this package is single-owner: a
+:class:`~reservoir_tpu.stream.bridge.DeviceStreamBridge` binds a fixed row
+layout at construction and ``result()``/``complete()`` are destructive
+one-shot reads.  The serve layer adds the missing multiplexing plane:
+
+- :mod:`.sessions` — a :class:`~reservoir_tpu.serve.sessions.SessionTable`
+  leasing reservoir rows of the batched engine to opaque session keys
+  (open/route/close, TTL + LRU eviction, generation counters so a recycled
+  row can never serve a stale read, counter-keyed Threefry sub-seeds so
+  recycled rows are statistically fresh without reseeding the engine);
+- :mod:`.service` — a :class:`~reservoir_tpu.serve.service.ReservoirService`
+  front-end: per-session ingest coalesced across sessions into the bridge's
+  interleaved tile path, admission control (bounded in-flight bytes,
+  reject-with-retry-after), live non-destructive snapshot queries served
+  from a ``flushed_seq``-keyed device->host cache, and crash recovery that
+  rebuilds the session table from a journaled session map.
+"""
+
+from .service import ReservoirService
+from .sessions import Session, SessionTable
+
+__all__ = ["ReservoirService", "Session", "SessionTable"]

@@ -1,0 +1,594 @@
+"""Multi-tenant reservoir service: many sessions, one batched device engine.
+
+:class:`ReservoirService` is the first traffic-facing entry point of the
+stack: it multiplexes dynamically arriving tenant sessions onto the rows of
+one :class:`~reservoir_tpu.stream.bridge.DeviceStreamBridge` (one device
+engine, tens of thousands of reservoir rows) and serves results while
+streams are still open.
+
+What it adds over the raw bridge:
+
+- **session lifecycle** — :meth:`open_session` / :meth:`ingest` /
+  :meth:`snapshot` / :meth:`close_session` against opaque string keys,
+  backed by the lease/evict :class:`~reservoir_tpu.serve.sessions.SessionTable`
+  (TTL + LRU eviction, generation-guarded recycling, counter-keyed Threefry
+  sub-seeds so a recycled row restarts statistically fresh without
+  reseeding the engine — :meth:`ReservoirEngine.reset_rows`);
+- **cross-session coalescing** — per-session ingests append to a pending
+  buffer and ship through the bridge's existing ``push_interleaved``
+  C-speed demux in batches, so ten thousand tiny ingests cost a handful of
+  scatter calls, not ten thousand;
+- **admission control** — a bounded in-flight byte budget; when it is
+  exceeded *and* the flush pipeline cannot absorb more
+  (:meth:`DeviceStreamBridge.flush_would_block`), ingest rejects with
+  :class:`~reservoir_tpu.errors.ServiceSaturated` carrying ``retry_after_s``
+  instead of queuing unboundedly;
+- **live snapshot queries** — :meth:`snapshot` is a NON-destructive
+  per-session result read (``ReservoirEngine.peek_arrays``), served from a
+  device->host snapshot cache keyed by ``(flushed_seq, reset_epoch)``; the
+  sampler never closes, so a session can be queried any number of times
+  mid-stream;
+- **robustness plane wiring** (ISSUE 3 → this layer): a ``serve.ingest``
+  fault-injection site whose failures surface as typed *per-session*
+  errors (:class:`~reservoir_tpu.errors.SessionIngestError`) — the service
+  stays live; crash recovery via :meth:`recover`, which rebuilds the
+  session table from a journaled session map (``sessions.jsonl`` next to
+  the bridge's checkpoint/journal pair) and re-applies journaled row
+  resets *between* the replayed flushes they originally fell between
+  (``DeviceStreamBridge.recover``'s ``replay_hook``) — reservoirs come
+  back bit-identical; and :class:`~reservoir_tpu.utils.metrics.ServiceMetrics`
+  surfaced through ``bench.py serve``.
+
+Thread-safety matches the stack below: one writer.  Put a lock or a queue
+in front for multi-producer traffic.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, List, Optional, Tuple
+
+import numpy as np
+
+from ..config import SamplerConfig
+from ..errors import (
+    RetryPolicy,
+    ServiceSaturated,
+    SessionIngestError,
+)
+from ..stream.bridge import DeviceStreamBridge
+from ..utils import faults as _faults
+from ..utils.metrics import ServiceMetrics
+from .sessions import Session, SessionTable
+
+__all__ = ["ReservoirService"]
+
+_JOURNAL_NAME = "sessions.jsonl"
+_JOURNAL_VERSION = 1
+
+
+def _read_session_journal(path: str) -> Tuple[dict, List[dict]]:
+    """Parse the session journal: ``(header, ops)``.  A torn final line
+    (crash mid-append) is dropped — the same tolerance the bridge's tile
+    journal extends to its tail record."""
+    with open(path, encoding="utf-8") as fh:
+        lines = fh.read().splitlines()
+    records: List[dict] = []
+    for i, line in enumerate(lines):
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            records.append(json.loads(line))
+        except json.JSONDecodeError:
+            if i == len(lines) - 1:
+                break  # torn tail: the op it described never completed
+            raise ValueError(
+                f"{path!r}: corrupt session journal at line {i + 1}"
+            )
+    if not records or records[0].get("op") != "base":
+        raise ValueError(
+            f"{path!r}: session journal has no base header record"
+        )
+    return records[0], records[1:]
+
+
+class ReservoirService:
+    """Serve many tenant sessions from one batched device engine.
+
+    Args:
+      config: engine configuration; ``num_reservoirs`` is the session
+        capacity (rows leasable at once) and ``distinct``/``weighted``
+        select the sampling mode every session of this service uses.
+      key: engine PRNG key/seed (per-row keys are split from it once).
+      ttl_s: idle lease time after which a session is evictable (sweep or
+        row pressure); ``None`` = LRU-only eviction.
+      session_seed: base seed of the per-lease sub-key schedule (recycled
+        rows draw from ``fold_in(fold_in(key(session_seed), row), gen)``).
+      coalesce_bytes: pending-ingest threshold at which the buffer ships
+        through ``push_interleaved`` (cross-session batching lever).
+      max_inflight_bytes: admission-control budget over pending bytes;
+        beyond it, ingest either flushes (pipeline willing) or rejects
+        with :class:`ServiceSaturated`.
+      retry_after_s: floor of the rejection's retry hint (the live hint
+        scales with the observed per-flush dispatch time).
+      pipelined / retry_policy / flush_timeout_s / checkpoint_dir /
+        checkpoint_every / faults: forwarded to the underlying
+        :class:`DeviceStreamBridge` (the ISSUE-3 robustness plane).  With
+        ``checkpoint_dir`` set the service additionally journals the
+        session map to ``sessions.jsonl`` there, which is what makes
+        :meth:`recover` possible.
+    """
+
+    def __init__(
+        self,
+        config: SamplerConfig,
+        key: Any = None,
+        *,
+        ttl_s: Optional[float] = None,
+        session_seed: int = 0,
+        coalesce_bytes: int = 1 << 16,
+        max_inflight_bytes: int = 1 << 24,
+        retry_after_s: float = 0.05,
+        pipelined: bool = True,
+        retry_policy: Optional[RetryPolicy] = None,
+        flush_timeout_s: Optional[float] = None,
+        checkpoint_dir: Optional[str] = None,
+        checkpoint_every: int = 64,
+        faults: Optional[Any] = None,
+        _bridge: Optional[DeviceStreamBridge] = None,
+        _table: Optional[SessionTable] = None,
+    ) -> None:
+        if coalesce_bytes <= 0 or max_inflight_bytes <= 0:
+            raise ValueError(
+                "coalesce_bytes and max_inflight_bytes must be positive"
+            )
+        if coalesce_bytes > max_inflight_bytes:
+            raise ValueError(
+                "coalesce_bytes must not exceed max_inflight_bytes (the "
+                "coalesce buffer is what the admission bound bounds)"
+            )
+        self._faults = faults
+        self._bridge = _bridge if _bridge is not None else DeviceStreamBridge(
+            config,
+            key=key,
+            reusable=True,  # the serve plane never spends the lifecycle
+            pipelined=pipelined,
+            retry_policy=retry_policy,
+            flush_timeout_s=flush_timeout_s,
+            checkpoint_dir=checkpoint_dir,
+            checkpoint_every=checkpoint_every,
+            faults=faults,
+        )
+        config = self._bridge._config
+        self._config = config
+        self._table = _table if _table is not None else SessionTable(
+            config.num_reservoirs, ttl_s=ttl_s, seed=session_seed
+        )
+        self._dtype = np.dtype(config.element_dtype)
+        self._coalesce_bytes = int(coalesce_bytes)
+        self._max_inflight_bytes = int(max_inflight_bytes)
+        self._retry_after_s = float(retry_after_s)
+        self._metrics = ServiceMetrics()
+        self._metrics.sessions_open = len(self._table)
+        # pending cross-session coalesce buffer: (rows, elems, weights)
+        # triples appended per ingest, shipped as ONE interleaved push
+        self._pend: List[Tuple[np.ndarray, np.ndarray, Optional[np.ndarray]]] = []
+        self._pend_bytes = 0
+        # snapshot cache: (samples, sizes) host arrays keyed by
+        # (flushed_seq, reset_epoch) — reset_epoch invalidates on row
+        # recycling, else a cached snapshot could leak the previous
+        # tenant's data into a freshly opened session
+        self._snap: Optional[Tuple[np.ndarray, np.ndarray]] = None
+        self._snap_key: Optional[Tuple[int, int]] = None
+        self._reset_epoch = 0
+        # session journal (crash recovery of the table itself)
+        self._journal_fh = None
+        if checkpoint_dir is not None:
+            path = os.path.join(checkpoint_dir, _JOURNAL_NAME)
+            if _bridge is None:
+                # fresh service: the bridge just wrote its seq-0 anchor and
+                # rotated its tile journal; start the session map fresh too
+                self._journal_fh = open(path, "w", encoding="utf-8")
+                self._append_journal(
+                    {
+                        "op": "base",
+                        "v": _JOURNAL_VERSION,
+                        "seed": self._table.seed,
+                        "rows": self._table.capacity,
+                        "ttl_s": self._table.ttl_s,
+                    }
+                )
+            else:
+                # recovery adoption: continue appending to the replayed map
+                self._journal_fh = open(path, "a", encoding="utf-8")
+
+    # ------------------------------------------------------------ properties
+
+    @property
+    def config(self) -> SamplerConfig:
+        return self._config
+
+    @property
+    def metrics(self) -> ServiceMetrics:
+        return self._metrics
+
+    @property
+    def table(self) -> SessionTable:
+        return self._table
+
+    @property
+    def bridge(self) -> DeviceStreamBridge:
+        return self._bridge
+
+    @property
+    def flushed_seq(self) -> int:
+        """The underlying bridge's durable flush watermark."""
+        return self._bridge.flushed_seq
+
+    def _append_journal(self, rec: dict) -> None:
+        if self._journal_fh is None:
+            return
+        self._journal_fh.write(json.dumps(rec) + "\n")
+        self._journal_fh.flush()
+
+    # ----------------------------------------------------------- lifecycle
+
+    def open_session(self, key: str) -> Session:
+        """Lease a reservoir row to ``key`` and return the live handle.
+
+        A full table evicts first (TTL-expired sessions, then the LRU
+        one); a recycled row (generation > 0) is reset on device with this
+        lease's counter-keyed sub-seed — after every element already
+        accepted for the previous tenant has been flushed, so no byte of
+        the old stream can bleed into the new one."""
+        sess, evicted = self._table.open(key)
+        for ev in evicted:
+            self._append_journal(
+                {
+                    "op": "evict",
+                    "key": ev.key,
+                    "row": ev.row,
+                    "at_seq": self._bridge.flushed_seq,
+                }
+            )
+            self._metrics.evictions += 1
+        at_seq = self._bridge.flushed_seq
+        if sess.generation > 0:
+            # recycle: the previous tenant's staged/pending elements must
+            # reach the device BEFORE the reset wipes the row (and the
+            # worker must be idle — reset shares the single-writer slot)
+            self.sync()
+            at_seq = self._bridge.flushed_seq
+            self._bridge.engine.reset_rows(
+                [sess.row], self._table.sub_key(sess.row, sess.generation)
+            )
+            self._reset_epoch += 1
+            self._metrics.recycles += 1
+        self._append_journal(
+            {
+                "op": "open",
+                "key": key,
+                "row": sess.row,
+                "gen": sess.generation,
+                "at_seq": at_seq,
+            }
+        )
+        self._metrics.sessions_opened += 1
+        self._metrics.sessions_open = len(self._table)
+        return sess
+
+    def close_session(self, key: str) -> np.ndarray:
+        """End ``key``'s lease and return its final sample (the same
+        non-destructive snapshot path — the engine stays open for every
+        other session).  The freed row recycles on a later open."""
+        final = self.snapshot(key)
+        sess = self._table.close(key)
+        self._append_journal(
+            {
+                "op": "close",
+                "key": key,
+                "row": sess.row,
+                "at_seq": self._bridge.flushed_seq,
+            }
+        )
+        self._metrics.closes += 1
+        self._metrics.sessions_open = len(self._table)
+        return final
+
+    def sweep_expired(self, now: Optional[float] = None) -> List[str]:
+        """Evict every TTL-expired session; returns their keys."""
+        evicted = self._table.sweep(now)
+        for ev in evicted:
+            self._append_journal(
+                {
+                    "op": "evict",
+                    "key": ev.key,
+                    "row": ev.row,
+                    "at_seq": self._bridge.flushed_seq,
+                }
+            )
+            self._metrics.evictions += 1
+        self._metrics.sessions_open = len(self._table)
+        return [ev.key for ev in evicted]
+
+    # -------------------------------------------------------------- ingest
+
+    def ingest(
+        self, key: str, elements: Any, weights: Optional[Any] = None
+    ) -> int:
+        """Accept a 1-D chunk of elements for session ``key``; returns the
+        count accepted.  Failures are scoped to this call — a typed
+        :class:`SessionIngestError` (or a :class:`ServiceSaturated`
+        rejection) leaves the service and every other session live.
+
+        The elements join the cross-session coalesce buffer and ship
+        through the bridge's interleaved demux once ``coalesce_bytes``
+        accumulate (or at the next sync/snapshot barrier)."""
+        sess = self._table.route(key)
+        try:
+            _faults.fire("serve.ingest", self._faults)
+        except Exception as e:
+            raise SessionIngestError(key, f"{type(e).__name__}: {e}") from e
+        try:
+            arr = np.atleast_1d(np.ascontiguousarray(elements, self._dtype))
+        except (TypeError, ValueError) as e:
+            raise SessionIngestError(
+                key, f"elements not convertible to {self._dtype}: {e}"
+            ) from None
+        if arr.ndim != 1:
+            raise SessionIngestError(
+                key, f"elements must be 1-D, got shape {arr.shape}"
+            )
+        warr: Optional[np.ndarray] = None
+        if self._config.weighted:
+            if weights is None:
+                raise SessionIngestError(
+                    key, "weighted service requires weights"
+                )
+            warr = np.atleast_1d(np.ascontiguousarray(weights, np.float32))
+            if warr.shape != arr.shape:
+                raise SessionIngestError(
+                    key,
+                    f"weights must match elements shape {arr.shape}, got "
+                    f"{warr.shape}",
+                )
+            if not np.all(warr >= 0):
+                bad = int(np.argmax(warr < 0))
+                raise SessionIngestError(
+                    key,
+                    f"weights must be nonnegative (weights[{bad}] = "
+                    f"{warr[bad]})",
+                )
+        elif weights is not None:
+            raise SessionIngestError(
+                key, "weights are only meaningful with weighted=True"
+            )
+        nbytes = arr.nbytes + (warr.nbytes if warr is not None else 0)
+        if nbytes > self._max_inflight_bytes:
+            raise SessionIngestError(
+                key,
+                f"single request of {nbytes} bytes exceeds "
+                f"max_inflight_bytes={self._max_inflight_bytes} (split it)",
+            )
+        # Admission: past the coalesce threshold a flush is due, but a
+        # saturated pipeline means flushing would BLOCK — buffer on while
+        # the hard byte budget allows, then reject with a retry hint.
+        # (Never block the ingest path on a slow device: bounded memory and
+        # an explicit 429 is the contract.)
+        saturated = (
+            self._pend_bytes + nbytes >= self._coalesce_bytes
+            and self._bridge.flush_would_block()
+        )
+        if saturated and self._pend_bytes + nbytes > self._max_inflight_bytes:
+            self._metrics.rejections += 1
+            raise ServiceSaturated(
+                f"in-flight bytes {self._pend_bytes + nbytes} over budget "
+                f"{self._max_inflight_bytes} with the flush pipeline "
+                "saturated",
+                retry_after_s=self._retry_hint(),
+            )
+        n = int(arr.shape[0])
+        self._pend.append(
+            (np.full(n, sess.row, np.int32), arr, warr)
+        )
+        self._pend_bytes += nbytes
+        sess.elements += n
+        self._metrics.ingested_elements += n
+        if self._pend_bytes >= self._coalesce_bytes and not saturated:
+            self._flush_pending()
+        return n
+
+    def _retry_hint(self) -> float:
+        """Retry-after estimate: the observed per-flush dispatch time (what
+        a permit actually takes to free), floored at ``retry_after_s``."""
+        m = self._bridge.metrics
+        per_flush = m.dispatch_s / m.flushes if m.flushes else 0.0
+        return max(self._retry_after_s, per_flush)
+
+    def _flush_pending(self) -> None:
+        """Ship the coalesce buffer as one interleaved push (rows filling
+        mid-batch flush tiles to the device as they do on the raw bridge)."""
+        if not self._pend:
+            return
+        pend, self._pend, self._pend_bytes = self._pend, [], 0
+        streams = np.concatenate([p[0] for p in pend])
+        elems = np.concatenate([p[1] for p in pend])
+        warr = (
+            np.concatenate([p[2] for p in pend])
+            if self._config.weighted
+            else None
+        )
+        self._bridge.push_interleaved(streams, elems, warr)
+        # kick rows the demux filled to the device now instead of waiting
+        # for the next push to overflow them — but never at the cost of
+        # blocking the ingest path (the pipeline overlaps the dispatch)
+        if not self._bridge.flush_would_block():
+            self._bridge.flush()
+
+    def sync(self) -> int:
+        """Barrier: coalesce buffer -> staging -> device, then wait out the
+        pipeline.  Returns the durable ``flushed_seq`` watermark — after
+        sync, every accepted element is journaled/applied and visible to
+        snapshots."""
+        self._flush_pending()
+        self._bridge.flush()
+        self._bridge.drain_barrier()
+        return self._bridge.flushed_seq
+
+    # ------------------------------------------------------------ snapshots
+
+    def snapshot(self, key: str, sync: bool = True) -> np.ndarray:
+        """LIVE per-session result read — non-destructive, any number of
+        times, while the session keeps streaming (the ``peek`` path; the
+        raw engine's ``result()`` stays terminal and untouched).
+
+        ``sync=True`` (default) gives read-your-writes: everything this
+        thread ingested is flushed and visible.  ``sync=False`` serves the
+        current durable watermark only (pending coalesced elements are not
+        yet visible) — cheaper under heavy ingest.
+
+        Reads are served from a whole-table device->host snapshot cache
+        keyed by ``(flushed_seq, reset_epoch)``: N sessions polling between
+        flushes cost ONE device readback, not N."""
+        sess = self._table.route(key)
+        self._table.check(sess)  # generation guard: no stale-row reads
+        if sync:
+            self.sync()
+        else:
+            # peek shares the engine's single-writer slot with the worker
+            self._bridge.drain_barrier()
+        cache_key = (self._bridge.flushed_seq, self._reset_epoch)
+        if self._snap_key != cache_key:
+            self._snap = self._bridge.engine.peek_arrays()
+            self._snap_key = cache_key
+            self._metrics.snapshot_misses += 1
+        else:
+            self._metrics.snapshot_hits += 1
+        samples, sizes = self._snap
+        return samples[sess.row, : int(sizes[sess.row])].copy()
+
+    # ------------------------------------------------------------- recovery
+
+    @classmethod
+    def recover(
+        cls,
+        checkpoint_dir: str,
+        *,
+        ttl_s: Optional[float] = None,
+        coalesce_bytes: int = 1 << 16,
+        max_inflight_bytes: int = 1 << 24,
+        retry_after_s: float = 0.05,
+        pipelined: Optional[bool] = None,
+        retry_policy: Optional[RetryPolicy] = None,
+        flush_timeout_s: Optional[float] = None,
+        checkpoint_every: Optional[int] = None,
+        faults: Optional[Any] = None,
+    ) -> "ReservoirService":
+        """Rebuild a crashed service from ``checkpoint_dir``.
+
+        Two journals replay together: the bridge's checkpoint + tile
+        journal rebuild the reservoirs, and ``sessions.jsonl`` rebuilds
+        the session table (leases, rows, generations, free-list order).
+        Row resets from session recycling are re-applied *between* the
+        replayed flushes they originally fell between (the ``replay_hook``
+        protocol), so recovered reservoirs are bit-identical to an
+        uninterrupted run — pinned by ``tests/test_serve.py``.
+
+        Elements ingested but never flushed (the coalesce buffer at crash
+        time) are not recoverable — they never left the producer's
+        custody; producers resume from :attr:`flushed_seq`, exactly the
+        raw bridge's contract."""
+        header, ops = _read_session_journal(
+            os.path.join(checkpoint_dir, _JOURNAL_NAME)
+        )
+        if ttl_s is None:
+            ttl_s = header.get("ttl_s")  # default to the crashed service's
+        table = SessionTable(
+            int(header["rows"]), ttl_s=ttl_s, seed=int(header["seed"])
+        )
+        resets: List[Tuple[int, int, int]] = []  # (at_seq, row, gen)
+        for rec in ops:
+            if rec["op"] == "open":
+                sess, evicted = table.open(rec["key"])
+                if evicted or sess.row != rec["row"] or (
+                    sess.generation != rec["gen"]
+                ):
+                    raise ValueError(
+                        f"session journal replay diverged at {rec!r}: "
+                        f"rebuilt lease (row={sess.row}, "
+                        f"gen={sess.generation}) does not match the record"
+                    )
+                if sess.generation > 0:
+                    resets.append(
+                        (int(rec["at_seq"]), sess.row, sess.generation)
+                    )
+            elif rec["op"] in ("close", "evict"):
+                table.close(rec["key"])
+            else:
+                raise ValueError(
+                    f"session journal: unknown op {rec.get('op')!r}"
+                )
+        # interleave journaled row resets into the tile replay at their
+        # original positions; resets the checkpoint already covers
+        # (at_seq < covered) are skipped — they are baked into its state
+        cursor = {"i": 0, "covered": None}
+
+        def replay_hook(bridge: DeviceStreamBridge, watermark: int) -> None:
+            if cursor["covered"] is None:
+                cursor["covered"] = watermark
+                while (
+                    cursor["i"] < len(resets)
+                    and resets[cursor["i"]][0] < watermark
+                ):
+                    cursor["i"] += 1
+            while (
+                cursor["i"] < len(resets)
+                and resets[cursor["i"]][0] <= watermark
+            ):
+                _, row, gen = resets[cursor["i"]]
+                bridge.engine.reset_rows([row], table.sub_key(row, gen))
+                cursor["i"] += 1
+
+        bridge = DeviceStreamBridge.recover(
+            checkpoint_dir,
+            pipelined=pipelined,
+            retry_policy=retry_policy,
+            flush_timeout_s=flush_timeout_s,
+            checkpoint_every=checkpoint_every,
+            faults=faults,
+            replay_hook=replay_hook,
+        )
+        service = cls(
+            bridge._config,
+            ttl_s=ttl_s,
+            coalesce_bytes=coalesce_bytes,
+            max_inflight_bytes=max_inflight_bytes,
+            retry_after_s=retry_after_s,
+            faults=faults,
+            checkpoint_dir=checkpoint_dir,
+            _bridge=bridge,
+            _table=table,
+        )
+        service._metrics.recoveries += 1
+        return service
+
+    # ------------------------------------------------------------- teardown
+
+    def shutdown(self) -> None:
+        """Flush everything pending, wait out the pipeline, and close the
+        session journal.  Sessions stay leased (the table is durable via
+        the journal) — this is a clean process exit, not a mass close."""
+        self.sync()
+        if self._journal_fh is not None:
+            self._journal_fh.close()
+            self._journal_fh = None
+
+    def __del__(self) -> None:
+        fh = getattr(self, "_journal_fh", None)
+        if fh is not None:
+            try:
+                fh.close()
+            except OSError:
+                pass

@@ -1,0 +1,229 @@
+"""Session table: lease/evict reservoir rows to opaque tenant session keys.
+
+The batched engine runs tens of thousands of independent reservoirs per
+device (*Parallel Streaming Random Sampling*, arXiv:1906.04120, is exactly
+this many-independent-substream shape); what maps dynamically arriving
+tenant sessions onto those rows is this table.  It is deliberately
+host-only and device-free: pure bookkeeping a service front-end
+(:mod:`reservoir_tpu.serve.service`) pairs with engine row resets.
+
+Design points:
+
+- **free-list + generation counters**: each row carries a monotonically
+  increasing generation, bumped whenever the row is freed.  A
+  :class:`Session` handle is a ``(row, generation)`` lease; :meth:`check`
+  refuses a handle whose generation moved on
+  (:class:`~reservoir_tpu.errors.StaleSessionError`) — a recycled row can
+  never serve another tenant's read.
+- **TTL + LRU eviction**: sessions idle past ``ttl_s`` are evictable
+  (:meth:`sweep`), and :meth:`open` on a full table evicts the
+  least-recently-used session (long-lived queryable handles in the style
+  of *StreamSampling.jl*, arXiv:2603.21996, must not leak rows forever).
+- **counter-keyed sub-seeds**: :meth:`sub_key` derives a per-lease Threefry
+  key by folding ``(row, generation)`` into a table-level base key — the
+  engine is never reseeded, yet every re-lease of a row gets a
+  statistically fresh, *deterministically replayable* draw stream
+  (the bit-exact-recovery contract of the serve plane).
+"""
+
+from __future__ import annotations
+
+import time
+from collections import OrderedDict, deque
+from typing import Callable, List, Optional, Tuple
+
+from ..errors import StaleSessionError, UnknownSessionError
+
+__all__ = ["Session", "SessionTable"]
+
+
+class Session:
+    """One live lease: session ``key`` owns reservoir ``row`` at
+    ``generation``.  ``elements`` counts ingested elements (the service
+    maintains it); ``opened_at``/``last_used`` drive TTL/LRU."""
+
+    __slots__ = (
+        "key", "row", "generation", "opened_at", "last_used", "elements"
+    )
+
+    def __init__(
+        self, key: str, row: int, generation: int, now: float
+    ) -> None:
+        self.key = key
+        self.row = row
+        self.generation = generation
+        self.opened_at = now
+        self.last_used = now
+        self.elements = 0
+
+    def __repr__(self) -> str:  # debugging aid, not API
+        return (
+            f"Session({self.key!r}, row={self.row}, "
+            f"gen={self.generation}, elements={self.elements})"
+        )
+
+
+class SessionTable:
+    """Lease ``num_rows`` reservoir rows to opaque session keys.
+
+    Args:
+      num_rows: rows available for lease (the engine's ``num_reservoirs``).
+      ttl_s: idle time after which a session becomes evictable by
+        :meth:`sweep` / lazily on :meth:`route` (``None`` disables TTL).
+      seed: base seed of the per-lease sub-key schedule (:meth:`sub_key`).
+      clock: monotonic time source (injectable for tests).
+
+    Single-writer like the engine and bridge it fronts: wrap calls in your
+    own lock for multi-producer use.  Keys must be strings — they are
+    journaled as JSON by the service's crash-recovery plane.
+    """
+
+    def __init__(
+        self,
+        num_rows: int,
+        *,
+        ttl_s: Optional[float] = None,
+        seed: int = 0,
+        clock: Callable[[], float] = time.monotonic,
+    ) -> None:
+        if num_rows <= 0:
+            raise ValueError("num_rows must be positive")
+        self._rows = int(num_rows)
+        self._ttl = ttl_s
+        self._seed = int(seed)
+        self._clock = clock
+        self._free: deque = deque(range(self._rows))
+        self._gen: List[int] = [0] * self._rows
+        # insertion order == recency order (route() moves to end): the
+        # front is always the LRU eviction candidate
+        self._sessions: "OrderedDict[str, Session]" = OrderedDict()
+        self._base_key = None  # jax key, built lazily (host-only until then)
+
+    # ------------------------------------------------------------ introspection
+
+    def __len__(self) -> int:
+        return len(self._sessions)
+
+    def __contains__(self, key: str) -> bool:
+        return key in self._sessions
+
+    @property
+    def capacity(self) -> int:
+        return self._rows
+
+    @property
+    def seed(self) -> int:
+        return self._seed
+
+    @property
+    def ttl_s(self) -> Optional[float]:
+        return self._ttl
+
+    def sessions(self) -> List[Session]:
+        """Live sessions in LRU order (least recently used first)."""
+        return list(self._sessions.values())
+
+    def generation_of(self, row: int) -> int:
+        """Current generation of ``row`` (bumped each time it is freed)."""
+        return self._gen[row]
+
+    # ----------------------------------------------------------------- leasing
+
+    def open(
+        self, key: str, now: Optional[float] = None
+    ) -> Tuple[Session, List[Session]]:
+        """Lease a row to ``key``.  Returns ``(session, evicted)`` where
+        ``evicted`` lists the LRU sessions removed to make room (at most
+        one).  Raises ``ValueError`` for a key that is already open and
+        :class:`UnknownSessionError` never — open is the entry point."""
+        if not isinstance(key, str):
+            raise TypeError(
+                f"session keys must be str (journaled as JSON), got "
+                f"{type(key).__name__}"
+            )
+        if key in self._sessions:
+            raise ValueError(f"session {key!r} is already open")
+        now = self._clock() if now is None else now
+        evicted: List[Session] = []
+        if not self._free:
+            # TTL-expired sessions go first; otherwise the LRU one pays
+            expired = self.sweep(now)
+            evicted.extend(expired)
+            if not self._free:
+                lru_key = next(iter(self._sessions))
+                evicted.append(self._remove(lru_key))
+        row = self._free.popleft()
+        sess = Session(key, row, self._gen[row], now)
+        self._sessions[key] = sess
+        return sess, evicted
+
+    def route(self, key: str, now: Optional[float] = None) -> Session:
+        """Resolve ``key`` to its live session (refreshing LRU recency).
+
+        TTL is a *lease* model, not a hard expiry: an idle session is
+        evicted only under row pressure (:meth:`open`) or by an explicit
+        :meth:`sweep` — never silently inside a lookup, because every
+        eviction must be journalable by the service's crash-recovery
+        plane.  Routing to an idle-but-unevicted session revives it."""
+        sess = self._sessions.get(key)
+        if sess is None:
+            raise UnknownSessionError(
+                f"session {key!r} is not open (never opened, closed, or "
+                "evicted)"
+            )
+        sess.last_used = self._clock() if now is None else now
+        self._sessions.move_to_end(key)
+        return sess
+
+    def check(self, sess: Session) -> None:
+        """Validate a held handle: the lease must still be current.  Raises
+        :class:`StaleSessionError` when the row's generation moved past the
+        handle (the row was freed, and possibly re-leased) — the guard that
+        makes a recycled row unable to serve a stale read."""
+        live = self._sessions.get(sess.key)
+        if live is sess and self._gen[sess.row] == sess.generation:
+            return
+        raise StaleSessionError(
+            f"session {sess.key!r} handle is stale: row {sess.row} is at "
+            f"generation {self._gen[sess.row]}, handle holds "
+            f"{sess.generation}"
+        )
+
+    def close(self, key: str) -> Session:
+        """End the lease: the row returns to the free list with its
+        generation bumped (any outstanding handle goes stale)."""
+        if key not in self._sessions:
+            raise UnknownSessionError(f"session {key!r} is not open")
+        return self._remove(key)
+
+    def sweep(self, now: Optional[float] = None) -> List[Session]:
+        """Evict every TTL-expired session; returns them (empty when TTL is
+        disabled).  The service journals each eviction."""
+        if self._ttl is None:
+            return []
+        now = self._clock() if now is None else now
+        expired = [
+            s for s in self._sessions.values()
+            if now - s.last_used > self._ttl
+        ]
+        return [self._remove(s.key) for s in expired]
+
+    def _remove(self, key: str) -> Session:
+        sess = self._sessions.pop(key)
+        self._gen[sess.row] += 1  # stale handles can never read this row
+        self._free.append(sess.row)
+        return sess
+
+    # ---------------------------------------------------------------- sub-keys
+
+    def sub_key(self, row: int, generation: int):
+        """Counter-keyed Threefry sub-seed for lease ``(row, generation)``:
+        ``fold_in(fold_in(key(seed), row), generation)``.  Pure counter
+        derivation — no mutable RNG state — so a recovery replay that sees
+        the same journaled ``(row, generation)`` pairs rebuilds the exact
+        same fresh-row randomness without reseeding the engine."""
+        import jax.random as jr
+
+        if self._base_key is None:
+            self._base_key = jr.key(self._seed)
+        return jr.fold_in(jr.fold_in(self._base_key, row), generation)

@@ -237,6 +237,15 @@ class _FlushPipeline:
         while not self._free.acquire(timeout=0.1):
             self._check()
 
+    def would_block(self) -> bool:
+        """True when a ``reserve()`` right now would block (no free host
+        tile — every permit is held by in-flight flushes).  The serving
+        plane's admission-control probe; never blocks itself."""
+        if self._free.acquire(blocking=False):
+            self._free.release()
+            return False
+        return True
+
     def release(self) -> None:
         """Return an unused reservation (the drain produced nothing)."""
         self._free.release()
@@ -531,6 +540,14 @@ class DeviceStreamBridge:
         return self._config.num_reservoirs
 
     @property
+    def engine(self) -> ReservoirEngine:
+        """The bridge's engine.  Read-side consumers (the serving plane's
+        snapshot path, recovery hooks) share the bridge's single-writer
+        contract: call :meth:`drain_barrier` before touching engine state
+        while a pipelined flush may be in flight."""
+        return self._engine
+
+    @property
     def sample(self) -> Future:
         """The bridge's materialized value: future of the per-stream samples
         (list of ``S`` arrays), completed by the tri-state protocol."""
@@ -558,12 +575,24 @@ class DeviceStreamBridge:
         weights: Optional[Any] = None,
     ) -> None:
         """Buffer one element or a 1-D chunk for logical stream ``stream``;
-        flushes automatically whenever the stream's row fills."""
+        flushes automatically whenever the stream's row fills.  Shape/dtype
+        errors name the offending stream — at 65k streams a bare
+        "weights must match" is undebuggable."""
         self._check_open()
         _faults.fire("bridge.demux", self._faults)
         self._metrics.start()
-        arr = np.atleast_1d(np.asarray(elements, self._tiles[0].dtype))
-        warr = self._check_weights(arr, weights)
+        if not 0 <= int(stream) < self.num_streams:
+            raise ValueError(
+                f"stream {int(stream)} out of range [0, {self.num_streams})"
+            )
+        try:
+            arr = np.atleast_1d(np.asarray(elements, self._tiles[0].dtype))
+        except (TypeError, ValueError) as e:
+            raise ValueError(
+                f"stream {int(stream)}: elements not convertible to "
+                f"{self._tiles[0].dtype}: {e}"
+            ) from None
+        warr = self._check_weights(arr, weights, stream=int(stream))
         off = 0
         n = arr.shape[0]
         while off < n:
@@ -608,18 +637,30 @@ class DeviceStreamBridge:
                 self.flush()
         self._metrics.elements += n
 
-    def _check_weights(self, arr, weights):
+    def _check_weights(self, arr, weights, stream: Optional[int] = None):
+        # ``stream`` (the single-stream push path) prefixes every error so
+        # the failing row is identifiable in a many-stream feed
+        where = "" if stream is None else f"stream {stream}: "
         if self._wtiles is not None:
             if weights is None:
-                raise ValueError("weighted bridge requires weights")
+                raise ValueError(f"{where}weighted bridge requires weights")
             warr = np.atleast_1d(np.ascontiguousarray(weights, np.float32))
             if warr.shape != arr.shape:
-                raise ValueError("weights must match elements shape")
+                raise ValueError(
+                    f"{where}weights must match elements shape "
+                    f"{arr.shape}, got {warr.shape}"
+                )
             if not np.all(warr >= 0):
-                raise ValueError("weights must be nonnegative")
+                bad = int(np.argmax(warr < 0))
+                raise ValueError(
+                    f"{where}weights must be nonnegative "
+                    f"(weights[{bad}] = {warr[bad]})"
+                )
             return warr
         if weights is not None:
-            raise ValueError("weights are only meaningful with weighted=True")
+            raise ValueError(
+                f"{where}weights are only meaningful with weighted=True"
+            )
         return None
 
     def push_tile(self, tile: Any, valid: Optional[Any] = None,
@@ -771,6 +812,13 @@ class DeviceStreamBridge:
         if self._pipeline is not None:
             self._pipeline.join()
 
+    def flush_would_block(self) -> bool:
+        """True when a :meth:`flush` right now would block waiting for the
+        in-flight pipeline (no free host tile).  Non-blocking — the serving
+        plane's admission-control probe (reject-with-retry-after instead of
+        queuing unboundedly).  Always False on unpipelined bridges."""
+        return self._pipeline is not None and self._pipeline.would_block()
+
     # -------------------------------------------------------- crash recovery
 
     @property
@@ -844,6 +892,8 @@ class DeviceStreamBridge:
         flush_timeout_s: Optional[float] = None,
         checkpoint_every: Optional[int] = None,
         faults: Optional[Any] = None,
+        *,
+        replay_hook: Optional[Any] = None,
     ) -> "DeviceStreamBridge":
         """Reconstruct a crashed auto-checkpointing bridge from its
         ``checkpoint_dir`` and replay the journaled post-checkpoint tail.
@@ -856,6 +906,14 @@ class DeviceStreamBridge:
         ``hash_fn`` are code, not data, and must be re-supplied when the
         bridge was built with them; ``pipelined``/``checkpoint_every``
         default to the crashed bridge's settings.
+
+        ``replay_hook(bridge, watermark)`` interleaves external engine
+        mutations into the replay at their original positions: it is called
+        once when state reaches the checkpoint's watermark (before any tile
+        replays) and again after each replayed tile with that tile's
+        sequence number.  The serving plane uses this to re-apply journaled
+        session row resets exactly between the flushes they originally fell
+        between — required for bit-exact recovery under session recycling.
         """
         from ..utils.checkpoint import load_engine
 
@@ -898,6 +956,8 @@ class DeviceStreamBridge:
         # checkpoint already covers — a crash between checkpoint write and
         # journal rotation leaves such records behind by design
         config = engine.config
+        if replay_hook is not None:
+            replay_hook(bridge, covered)
         for seq, tile, valid, wtile in _FlushJournal.replay(
             os.path.join(checkpoint_dir, "journal.bin"),
             config.num_reservoirs,
@@ -913,6 +973,8 @@ class DeviceStreamBridge:
             m.flushes += 1
             m.elements += total
             m.flushed_elements += total
+            if replay_hook is not None:
+                replay_hook(bridge, seq)
         m.recoveries += 1
         return bridge
 
@@ -1028,24 +1090,43 @@ class DeviceSampler:
     def sample_all(self, elements: Any) -> None:
         """Bulk path: array-shaped input flushes in whole tiles without the
         per-element loop (the ``sampleAll`` fast-path analog,
-        ``Sampler.scala:261-287``)."""
+        ``Sampler.scala:261-287``).  A dtype/shape error names the element
+        range that failed to convert, not just the target dtype."""
         self._check_open()
         if not isinstance(elements, np.ndarray) and not hasattr(elements, "__len__"):
             # generator/iterator source (the Sampler ABC accepts any iterable)
-            for e in elements:
-                self.sample(e)
+            for i, e in enumerate(elements):
+                try:
+                    self.sample(e)
+                except (TypeError, ValueError) as e_:
+                    raise ValueError(
+                        f"elements[{i}] not storable as "
+                        f"{self._buf.dtype}: {e_}"
+                    ) from None
             return
         arr = np.asarray(elements) if not isinstance(elements, np.ndarray) else elements
         if arr.dtype == object or arr.ndim != 1:
-            for e in np.ravel(arr):
-                self.sample(e)
+            for i, e in enumerate(np.ravel(arr)):
+                try:
+                    self.sample(e)
+                except (TypeError, ValueError) as e_:
+                    raise ValueError(
+                        f"elements[{i}] not storable as "
+                        f"{self._buf.dtype}: {e_}"
+                    ) from None
             return
         B = self._buf.shape[0]
         off = 0
         n = arr.shape[0]
         while off < n:
             take = min(B - self._fill, n - off)
-            self._buf[self._fill : self._fill + take] = arr[off : off + take]
+            try:
+                self._buf[self._fill : self._fill + take] = arr[off : off + take]
+            except (TypeError, ValueError) as e:
+                raise ValueError(
+                    f"elements[{off}:{off + take}] (dtype {arr.dtype}) not "
+                    f"storable as {self._buf.dtype}: {e}"
+                ) from None
             self._fill += take
             off += take
             if self._fill >= B:

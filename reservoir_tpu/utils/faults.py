@@ -61,8 +61,10 @@ __all__ = [
 #: the stream bridge's demux (producer thread) and device dispatch (worker
 #: thread), ``engine.update`` on every engine tile update, ``engine.pallas``
 #: only when a tile is about to dispatch to a Pallas kernel (the demotion
-#: trigger), ``checkpoint.write`` inside the atomic checkpoint writer, and
-#: ``native.staging`` on the staging buffer's push/drain paths.
+#: trigger), ``checkpoint.write`` inside the atomic checkpoint writer,
+#: ``native.staging`` on the staging buffer's push/drain paths, and
+#: ``serve.ingest`` on the serving plane's per-session ingest (surfaced to
+#: the caller as a typed per-session error — the service stays live).
 SITES: Tuple[str, ...] = (
     "bridge.dispatch",
     "bridge.demux",
@@ -70,6 +72,7 @@ SITES: Tuple[str, ...] = (
     "engine.update",
     "engine.pallas",
     "native.staging",
+    "serve.ingest",
 )
 
 

@@ -16,6 +16,47 @@ from typing import Dict, Optional
 
 
 @dataclasses.dataclass
+class ServiceMetrics:
+    """Counter block of one :class:`~reservoir_tpu.serve.service.ReservoirService`
+    (single-writer like :class:`BridgeMetrics`; the bridge underneath keeps
+    its own counters — these are the session-plane ones).
+
+    ``sessions_open`` is the live lease count; ``evictions`` counts TTL/LRU
+    removals (``closes`` are explicit); ``recycles`` counts rows re-leased to
+    a new tenant (each one is an engine row reset); ``snapshot_hits`` /
+    ``snapshot_misses`` split live snapshot reads by whether the
+    ``flushed_seq``-keyed device->host cache served them; ``rejections``
+    counts admission-control 429s (:class:`~reservoir_tpu.errors.ServiceSaturated`).
+    """
+
+    sessions_open: int = 0
+    sessions_opened: int = 0
+    closes: int = 0
+    evictions: int = 0
+    recycles: int = 0
+    snapshot_hits: int = 0
+    snapshot_misses: int = 0
+    rejections: int = 0
+    ingested_elements: int = 0
+    recoveries: int = 0
+
+    def snapshot(self) -> Dict[str, float]:
+        """Point-in-time dict view (the bench/capture row format)."""
+        return {
+            "sessions_open": self.sessions_open,
+            "sessions_opened": self.sessions_opened,
+            "closes": self.closes,
+            "evictions": self.evictions,
+            "recycles": self.recycles,
+            "snapshot_hits": self.snapshot_hits,
+            "snapshot_misses": self.snapshot_misses,
+            "rejections": self.rejections,
+            "ingested_elements": self.ingested_elements,
+            "recoveries": self.recoveries,
+        }
+
+
+@dataclasses.dataclass
 class BridgeMetrics:
     """Mutable counter block owned by one bridge (single-writer, like the
     sampler itself — not synchronized)."""

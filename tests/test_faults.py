@@ -150,7 +150,8 @@ def test_disabled_plane_is_zero_overhead_noop(monkeypatch):
 
 def test_all_sites_exercised(tmp_path):
     # a rule-free global plane counts hits without raising: one bridge
-    # stream with auto-checkpointing must cross every site of ISSUE 3
+    # stream with auto-checkpointing must cross every site of ISSUE 3,
+    # plus one serve-plane ingest for the ISSUE-4 site
     with faults.active(FaultPlane()) as plane:
         bridge = DeviceStreamBridge(
             _cfg(),
@@ -166,6 +167,12 @@ def test_all_sites_exercised(tmp_path):
         # engine.pallas fires only on the Pallas dispatch branch
         eng = ReservoirEngine(_cfg(impl="pallas"), key=0, reusable=True)
         eng.sample(np.arange(16, dtype=np.int32).reshape(2, 8))
+        # serve.ingest fires on the serving plane's per-session ingest
+        from reservoir_tpu.serve import ReservoirService
+
+        svc = ReservoirService(_cfg(), key=0)
+        svc.open_session("s")
+        svc.ingest("s", np.arange(4, dtype=np.int32))
         hits = plane.hits()
     for site in faults.SITES:
         assert hits.get(site, 0) >= 1, (site, hits)
@@ -489,6 +496,58 @@ def test_recover_rejects_plain_engine_checkpoint(tmp_path):
     eng.save(str(d / "engine.npz"))
     with pytest.raises(ValueError, match="auto-checkpointing bridge"):
         DeviceStreamBridge.recover(str(d))
+
+
+# ------------------------------------------------------- serve.ingest site
+
+
+def test_serve_ingest_fault_is_typed_and_per_session():
+    """The ISSUE-4 matrix entry: an injected failure at ``serve.ingest``
+    surfaces as a typed per-session error
+    (:class:`~reservoir_tpu.errors.SessionIngestError` naming the session,
+    with the injected cause chained), NOT a wedged service — other
+    sessions and the failing session itself keep working."""
+    from reservoir_tpu.errors import SessionIngestError
+    from reservoir_tpu.serve import ReservoirService
+
+    plane = FaultPlane(
+        [FaultRule("serve.ingest", exc=TransientDeviceError, after=1,
+                   times=1, message="injected ingest fault")]
+    )
+    svc = ReservoirService(_cfg(), key=4, faults=plane)
+    svc.open_session("a")
+    svc.open_session("b")
+    svc.ingest("a", np.arange(8, dtype=np.int32))  # hit 0: passes
+    with pytest.raises(SessionIngestError, match="session 'b'") as exc_info:
+        svc.ingest("b", np.arange(8, dtype=np.int32))  # hit 1: injected
+    assert isinstance(exc_info.value.__cause__, TransientDeviceError)
+    assert exc_info.value.session == "b"
+    # not a wedge: both sessions keep ingesting and snapshotting
+    svc.ingest("b", np.arange(8, dtype=np.int32))
+    svc.ingest("a", np.arange(8, dtype=np.int32))
+    assert svc.snapshot("a").size > 0
+    assert svc.snapshot("b").size > 0
+    # the failed call cost session b nothing but its own elements
+    assert svc.table.route("a").elements == 16
+    assert svc.table.route("b").elements == 8
+
+
+def test_serve_ingest_fault_via_env_spec(monkeypatch):
+    # the global activation path reaches the serve site too
+    from reservoir_tpu.errors import SessionIngestError
+    from reservoir_tpu.serve import ReservoirService
+
+    monkeypatch.setenv(
+        "RESERVOIR_FAULTS", "serve.ingest:exc=RuntimeError,times=1"
+    )
+    faults.install_from_env()
+    svc = ReservoirService(_cfg(), key=5)
+    svc.open_session("a")
+    with pytest.raises(SessionIngestError):
+        svc.ingest("a", np.arange(4, dtype=np.int32))
+    svc.ingest("a", np.arange(4, dtype=np.int32))  # times=1: exhausted
+    monkeypatch.delenv("RESERVOIR_FAULTS")
+    faults.install_from_env()
 
 
 # -------------------------------------------------------- Pallas demotion

@@ -1,0 +1,629 @@
+"""Serving plane: session table, live snapshots, recycling, recovery, soak.
+
+The serve layer (ISSUE 4) is the first traffic-facing subsystem: a
+:class:`SessionTable` leases reservoir rows of the batched engine to opaque
+session keys, and a :class:`ReservoirService` coalesces per-session ingest
+into the bridge's interleaved tile path, answers NON-destructive snapshot
+queries while streams are open, applies admission control, and recovers the
+whole plane (reservoirs + session map) bit-exactly after a crash.
+
+The oracle used throughout: a session on lease ``(row, generation)`` must
+hold exactly the sample a 1-row engine produces when started from that
+lease's initial row state (the engine-init row slice at generation 0, the
+counter-keyed sub-seed init afterwards) and fed the session's elements —
+tile-split invariance makes the comparison bit-exact, not statistical.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import gc
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+import jax.random as jr
+
+from reservoir_tpu import SamplerConfig
+from reservoir_tpu.engine import ReservoirEngine
+from reservoir_tpu.errors import (
+    SamplerClosedError,
+    ServiceSaturated,
+    SessionIngestError,
+    StaleSessionError,
+    UnknownSessionError,
+)
+from reservoir_tpu.serve import ReservoirService, SessionTable
+from reservoir_tpu.stream.bridge import DeviceSampler, DeviceStreamBridge
+from reservoir_tpu.utils.faults import FaultPlane, FaultRule
+
+
+def _cfg(mode="plain", **kw):
+    kw.setdefault("max_sample_size", 4)
+    kw.setdefault("num_reservoirs", 8)
+    kw.setdefault("tile_size", 8)
+    return SamplerConfig(
+        distinct=(mode == "distinct"), weighted=(mode == "weighted"), **kw
+    )
+
+
+def _mode_ops(cfg):
+    if cfg.distinct:
+        from reservoir_tpu.ops import distinct as ops
+    elif cfg.weighted:
+        from reservoir_tpu.ops import weighted as ops
+    else:
+        from reservoir_tpu.ops import algorithm_l as ops
+    return ops
+
+
+_FULL_INIT_CACHE: dict = {}
+
+
+def _oracle_row_state(cfg, engine_seed, table, row, generation):
+    """The 1-row initial state of lease ``(row, generation)``: the engine
+    init's row slice at generation 0, the counter-keyed sub-seed init for
+    every recycled generation — exactly what the service installs."""
+    ops = _mode_ops(cfg)
+    kwargs = dict(
+        sample_dtype=jnp.dtype(cfg.resolved_sample_dtype()),
+        count_dtype=(
+            cfg.count_dtype
+            if cfg.count_dtype == "wide"
+            else jnp.dtype(cfg.count_dtype)
+        ),
+    )
+    if generation == 0:
+        cache_key = (cfg, engine_seed)
+        full = _FULL_INIT_CACHE.get(cache_key)
+        if full is None:
+            full = ops.init(
+                jr.key(engine_seed), cfg.num_reservoirs, cfg.max_sample_size,
+                **kwargs,
+            )
+            _FULL_INIT_CACHE[cache_key] = full
+        return jax.tree.map(lambda x: x[row : row + 1], full)
+    return ops.init(
+        table.sub_key(row, generation), 1, cfg.max_sample_size, **kwargs
+    )
+
+
+def _oracle_replay(cfg, engine_seed, table, sess, elems, weights=None):
+    """Replay one session's elements through a fresh 1-row engine from its
+    lease's initial state; returns the truncated sample."""
+    state1 = _oracle_row_state(cfg, engine_seed, table, sess.row, sess.generation)
+    cfg1 = dataclasses.replace(cfg, num_reservoirs=1)
+    eng = ReservoirEngine(cfg1, _initial_state=state1)
+    elems = np.asarray(elems, np.dtype(cfg.element_dtype))
+    if elems.size:
+        w = (
+            np.asarray(weights, np.float32)[None, :]
+            if weights is not None
+            else None
+        )
+        eng.sample(elems[None, :], weights=w)
+    samples, sizes = eng.peek_arrays()
+    return samples[0, : int(sizes[0])]
+
+
+# ------------------------------------------------------------ session table
+
+
+class _Clock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+def test_table_open_route_close_and_generations():
+    table = SessionTable(4, seed=3)
+    a, evicted = table.open("a")
+    assert evicted == [] and a.row == 0 and a.generation == 0
+    assert table.route("a") is a
+    assert "a" in table and len(table) == 1
+    closed = table.close("a")
+    assert closed is a
+    assert table.generation_of(0) == 1  # freed rows bump their generation
+    with pytest.raises(UnknownSessionError):
+        table.route("a")
+    with pytest.raises(UnknownSessionError):
+        table.close("a")
+    # the stale handle can never read its old row again
+    with pytest.raises(StaleSessionError):
+        table.check(a)
+    b, _ = table.open("b")
+    assert b.row == 1  # FIFO free list: fresh rows before recycled ones
+    with pytest.raises(ValueError, match="already open"):
+        table.open("b")
+    with pytest.raises(TypeError, match="must be str"):
+        table.open(42)
+
+
+def test_table_lru_eviction_and_recycle_order():
+    table = SessionTable(2)
+    table.open("a")
+    table.open("b")
+    table.route("a")  # a becomes most-recent; b is now LRU
+    c, evicted = table.open("c")
+    assert [e.key for e in evicted] == ["b"]
+    assert c.row == evicted[0].row and c.generation == 1
+    with pytest.raises(UnknownSessionError):
+        table.route("b")
+
+
+def test_table_ttl_sweep_and_pressure_eviction():
+    clock = _Clock()
+    table = SessionTable(2, ttl_s=10.0, clock=clock)
+    table.open("a")
+    clock.t = 5.0
+    table.open("b")
+    assert table.sweep() == []  # nobody idle past TTL yet
+    clock.t = 12.0  # a idle 12s, b idle 7s
+    swept = table.sweep()
+    assert [s.key for s in swept] == ["a"]
+    # routing revives recency (TTL is a lease, not a hard expiry)
+    table.route("b")
+    clock.t = 30.0
+    # pressure eviction prefers the TTL-expired set before LRU
+    table.open("c")
+    _, evicted = table.open("d")
+    assert [e.key for e in evicted] == ["b"]
+
+
+def test_table_sub_key_is_deterministic_and_fresh_per_generation():
+    table = SessionTable(4, seed=9)
+    k_a = table.sub_key(1, 1)
+    assert jnp.array_equal(jr.key_data(k_a), jr.key_data(table.sub_key(1, 1)))
+    # distinct (row, gen) pairs give distinct keys
+    others = [table.sub_key(1, 2), table.sub_key(2, 1), table.sub_key(0, 0)]
+    for o in others:
+        assert not jnp.array_equal(jr.key_data(k_a), jr.key_data(o))
+    # and a different base seed gives a different schedule
+    assert not jnp.array_equal(
+        jr.key_data(k_a), jr.key_data(SessionTable(4, seed=10).sub_key(1, 1))
+    )
+
+
+# ------------------------------------------------- engine peek + row resets
+
+
+@pytest.mark.parametrize("mode", ["plain", "weighted", "distinct"])
+def test_peek_arrays_is_non_destructive_and_result_unchanged(mode):
+    cfg = _cfg(mode, num_reservoirs=3)
+    eng = ReservoirEngine(cfg, key=5)  # single-use: the strictest lifecycle
+    ref = ReservoirEngine(cfg, key=5)
+    tile = np.arange(24, dtype=np.int32).reshape(3, 8)
+    w = np.linspace(0.5, 2.0, 24, dtype=np.float32).reshape(3, 8)
+    kw = {"weights": w} if mode == "weighted" else {}
+    eng.sample(tile, **kw)
+    ref.sample(tile, **kw)
+    peek1 = eng.peek_arrays()
+    # peeking closes nothing and perturbs nothing: stream on, peek again
+    assert eng.is_open
+    eng.sample(tile + 100, **kw)
+    ref.sample(tile + 100, **kw)
+    peek2 = eng.peek_arrays()
+    assert not np.array_equal(peek1[0], peek2[0]) or mode == "distinct"
+    # result() semantics and the single-use lifecycle are UNCHANGED: the
+    # same arrays a never-peeked engine returns, then closed for good
+    res = eng.result_arrays()
+    ref_res = ref.result_arrays()
+    np.testing.assert_array_equal(res[0], ref_res[0])
+    np.testing.assert_array_equal(res[1], ref_res[1])
+    np.testing.assert_array_equal(peek2[0], res[0])  # peek saw the same state
+    assert not eng.is_open
+    with pytest.raises(SamplerClosedError):
+        eng.peek_arrays()  # closed engines don't peek either
+    with pytest.raises(SamplerClosedError):
+        eng.result_arrays()
+
+
+def test_reset_rows_resets_only_named_rows_bit_exactly():
+    cfg = _cfg(num_reservoirs=4)
+    eng = ReservoirEngine(cfg, key=1, reusable=True)
+    ref = ReservoirEngine(cfg, key=1, reusable=True)
+    tile = np.arange(32, dtype=np.int32).reshape(4, 8)
+    eng.sample(tile)
+    ref.sample(tile)
+    table = SessionTable(4, seed=0)
+    eng.reset_rows([1, 3], table.sub_key(1, 1))
+    samples, sizes = eng.peek_arrays()
+    ref_samples, ref_sizes = ref.peek_arrays()
+    assert sizes[1] == 0 and sizes[3] == 0  # reset rows are empty
+    for r in (0, 2):  # untouched rows bit-identical
+        np.testing.assert_array_equal(samples[r], ref_samples[r])
+        assert sizes[r] == ref_sizes[r]
+    # the reset rows stream again, with generation-fresh draws: the same
+    # elements land differently than the generation-0 row did
+    eng.sample(tile)
+    s2, z2 = eng.peek_arrays()
+    assert z2[1] == cfg.max_sample_size
+    with pytest.raises(ValueError, match="out of range"):
+        eng.reset_rows([7], table.sub_key(7, 1))
+
+
+# ------------------------------------------------ error-message satellites
+
+
+def test_bridge_push_errors_name_the_stream():
+    bridge = DeviceStreamBridge(_cfg("weighted"), key=0)
+    with pytest.raises(ValueError, match=r"stream 3: weighted bridge requires"):
+        bridge.push(3, [1, 2])
+    with pytest.raises(ValueError, match=r"stream 5: weights must match"):
+        bridge.push(5, [1, 2], weights=[1.0])
+    with pytest.raises(ValueError, match=r"stream 2: weights must be nonnegative \(weights\[1\]"):
+        bridge.push(2, [1, 2], weights=[1.0, -3.0])
+    with pytest.raises(ValueError, match=r"stream 99 out of range \[0, 8\)"):
+        bridge.push(99, [1], weights=[1.0])
+    plain = DeviceStreamBridge(_cfg(), key=0)
+    with pytest.raises(ValueError, match=r"stream 4: elements not convertible"):
+        plain.push(4, ["not-an-int"])
+
+
+def test_push_interleaved_names_offending_position():
+    bridge = DeviceStreamBridge(_cfg(), key=0)
+    streams = np.array([0, 1, 42, 2], np.int32)
+    with pytest.raises(
+        ValueError, match=r"stream id 42 out of range \[0, 8\) at position 2"
+    ):
+        bridge.push_interleaved(streams, np.arange(4, dtype=np.int32))
+
+
+def test_engine_sample_all_names_offending_item():
+    eng = ReservoirEngine(_cfg(num_reservoirs=2), key=0, reusable=True)
+    good = np.zeros((2, 8), np.int32)
+    bad = np.zeros((3, 8), np.int32)  # wrong R
+    with pytest.raises(ValueError, match=r"tiles\[1\]: tile must be"):
+        eng.sample_all([good, bad])
+
+
+def test_device_sampler_sample_all_names_offending_elements():
+    s = DeviceSampler(_cfg(num_reservoirs=1), key=0)
+    with pytest.raises(ValueError, match=r"elements\[0:2\].*not\s+storable"):
+        s.sample_all(np.array(["a", "b"]))
+    s2 = DeviceSampler(_cfg(num_reservoirs=1), key=0)
+    with pytest.raises(ValueError, match=r"elements\[1\] not storable"):
+        s2.sample_all(iter([1, "nope"]))
+
+
+# ------------------------------------------------------------- the service
+
+
+@pytest.mark.parametrize("mode", ["plain", "weighted", "distinct"])
+def test_service_snapshots_match_oracle_replay(mode):
+    cfg = _cfg(mode, num_reservoirs=6, max_sample_size=3)
+    svc = ReservoirService(cfg, key=11, coalesce_bytes=64)
+    rng = np.random.default_rng(0)
+    fed = {}
+    for i in range(6):
+        key = f"s{i}"
+        svc.open_session(key)
+        elems = ((i + 1) * 1000 + rng.integers(0, 500, 20)).astype(np.int32)
+        w = rng.uniform(0.1, 2.0, 20).astype(np.float32) if mode == "weighted" else None
+        svc.ingest(key, elems, weights=w)
+        fed[key] = (elems, w)
+    for i in range(6):
+        key = f"s{i}"
+        got = svc.snapshot(key)
+        sess = svc.table.route(key)
+        want = _oracle_replay(cfg, 11, svc.table, sess, *fed[key])
+        np.testing.assert_array_equal(got, want)
+        # zero cross-session leakage: every value is from this session's range
+        assert np.all((got >= (i + 1) * 1000) & (got < (i + 1) * 1000 + 500))
+    # snapshots are live: the engine is still open and streaming continues
+    svc.ingest("s0", fed["s0"][0] + 7, weights=fed["s0"][1])
+    assert svc.snapshot("s0").size > 0
+
+
+def test_service_snapshot_cache_keyed_by_flushed_seq():
+    svc = ReservoirService(_cfg(), key=0)
+    svc.open_session("a")
+    svc.ingest("a", np.arange(20, dtype=np.int32))
+    svc.snapshot("a")
+    misses = svc.metrics.snapshot_misses
+    for _ in range(5):  # nothing flushed in between: all cache hits
+        svc.snapshot("a")
+    assert svc.metrics.snapshot_misses == misses
+    assert svc.metrics.snapshot_hits >= 5
+    svc.ingest("a", np.arange(20, dtype=np.int32))  # advances flushed_seq
+    svc.snapshot("a")
+    assert svc.metrics.snapshot_misses == misses + 1
+
+
+def test_service_recycle_resets_row_and_cache():
+    # the leak this guards: a cached snapshot from before a recycle must
+    # never serve the previous tenant's data to the new session
+    cfg = _cfg(num_reservoirs=2, max_sample_size=4)
+    svc = ReservoirService(cfg, key=3)
+    svc.open_session("a")
+    svc.open_session("b")
+    svc.ingest("a", np.arange(1000, 1030, dtype=np.int32))
+    svc.snapshot("a")  # populate the cache at this watermark
+    svc.close_session("a")
+    svc.open_session("c")  # recycles a's row (generation 1)
+    got = svc.snapshot("c")
+    assert got.size == 0, f"previous tenant's data leaked: {got}"
+    assert svc.metrics.recycles == 1
+    # and the fresh lease samples with fresh randomness
+    svc.ingest("c", np.arange(2000, 2030, dtype=np.int32))
+    got = svc.snapshot("c")
+    sess = svc.table.route("c")
+    want = _oracle_replay(
+        cfg, 3, svc.table, sess, np.arange(2000, 2030, dtype=np.int32)
+    )
+    np.testing.assert_array_equal(got, want)
+
+
+def test_service_routes_errors_per_session():
+    svc = ReservoirService(_cfg(), key=0)
+    with pytest.raises(UnknownSessionError):
+        svc.ingest("ghost", [1])
+    with pytest.raises(UnknownSessionError):
+        svc.snapshot("ghost")
+    svc.open_session("a")
+    with pytest.raises(SessionIngestError, match=r"session 'a'.*not convertible"):
+        svc.ingest("a", ["x"])
+    with pytest.raises(SessionIngestError, match=r"must be 1-D"):
+        svc.ingest("a", np.zeros((2, 2), np.int32))
+    with pytest.raises(SessionIngestError, match="weights are only meaningful"):
+        svc.ingest("a", [1], weights=[1.0])
+    # the failed calls cost the session nothing and the service is live
+    svc.ingest("a", np.arange(10, dtype=np.int32))
+    assert svc.snapshot("a").size > 0
+
+
+def test_admission_control_rejects_with_retry_after():
+    # hold the single zero-copy flush permit with a delay-injected dispatch
+    # (a slow device), then overfill the pending budget: ingest must reject
+    # with a typed 429 carrying a retry hint, not queue unboundedly
+    plane = FaultPlane(
+        [FaultRule("bridge.dispatch", exc=None, delay=0.5, times=1)]
+    )
+    svc = ReservoirService(
+        _cfg(num_reservoirs=2, tile_size=4),
+        key=0,
+        faults=plane,
+        coalesce_bytes=16,
+        max_inflight_bytes=64,
+    )
+    svc.open_session("a")
+    # fills row a's tile -> flush -> worker sleeps 0.5s holding the permit
+    svc.ingest("a", np.arange(4, dtype=np.int32))
+    with pytest.raises(ServiceSaturated) as exc_info:
+        for i in range(8):  # overfill the 64-byte pending budget
+            svc.ingest("a", np.arange(8, dtype=np.int32))
+    assert exc_info.value.retry_after_s > 0
+    assert svc.metrics.rejections == 1
+    # the rejection is not a wedge: once the device drains, ingest resumes
+    svc.sync()
+    svc.ingest("a", np.arange(8, dtype=np.int32))
+    assert svc.snapshot("a").size > 0
+
+
+def test_ttl_sweep_through_service():
+    clock = _Clock()
+    svc = ReservoirService(_cfg(), key=0, ttl_s=10.0)
+    svc._table._clock = clock  # injectable clock, service-side
+    svc.open_session("a")
+    clock.t = 5.0
+    svc.open_session("b")
+    clock.t = 12.0  # a idle 12s > ttl, b idle 7s
+    assert svc.sweep_expired() == ["a"]
+    assert svc.metrics.evictions == 1
+    with pytest.raises(UnknownSessionError):
+        svc.snapshot("a")
+    assert svc.snapshot("b").size == 0  # b survived
+
+
+# ----------------------------------------------- recycling fuzz + recovery
+
+
+@pytest.mark.parametrize("mode", ["plain", "weighted", "distinct"])
+def test_fuzz_recycle_under_load_with_recovery(tmp_path, mode):
+    """The satellite matrix: fuzz open -> ingest -> evict -> reopen across
+    all three modes, asserting (a) zero cross-session sample leakage,
+    (b) snapshots bit-identical to an oracle replay of that session's
+    elements, and (c) bit-identical replay after ``recover()``."""
+    cfg = _cfg(mode, num_reservoirs=5, max_sample_size=3, tile_size=8)
+    ck = str(tmp_path / "ck")
+    svc = ReservoirService(
+        cfg, key=21, checkpoint_dir=ck, checkpoint_every=3, coalesce_bytes=64
+    )
+    rng = np.random.default_rng(42)
+    fed: dict = {}  # key -> (elems list, weights list)
+    next_id = 0
+    live: list = []
+    for step in range(120):
+        op = rng.random()
+        if (op < 0.25 and len(live) < 12) or not live:
+            key = f"s{next_id}"
+            next_id += 1
+            svc.open_session(key)  # evicts LRU beyond 5 rows
+            live = [k for k in live if k in svc.table] + [key]
+            fed[key] = ([], [])
+        elif op < 0.8:
+            key = live[int(rng.integers(len(live)))]
+            if key not in svc.table:
+                live.remove(key)
+                continue
+            n = int(rng.integers(1, 12))
+            base = (int(key[1:]) + 1) * 10_000
+            elems = (base + rng.integers(0, 5000, n)).astype(np.int32)
+            w = rng.uniform(0.1, 3.0, n).astype(np.float32)
+            svc.ingest(
+                key, elems, weights=w if mode == "weighted" else None
+            )
+            fed[key][0].extend(elems.tolist())
+            fed[key][1].extend(w.tolist())
+        else:
+            key = live[int(rng.integers(len(live)))]
+            if key in svc.table:
+                svc.close_session(key)
+            live.remove(key)
+    assert svc.metrics.recycles > 0, "fuzz never exercised recycling"
+    # (a) + (b): every live session's snapshot is exactly its own replay
+    open_keys = [s.key for s in svc.table.sessions()]
+    for key in open_keys:
+        got = svc.snapshot(key)
+        base = (int(key[1:]) + 1) * 10_000
+        assert np.all((got >= base) & (got < base + 5000)), (
+            f"cross-session leakage in {key}: {got}"
+        )
+        sess = svc.table.route(key)
+        want = _oracle_replay(
+            cfg, 21, svc.table, sess,
+            np.asarray(fed[key][0], np.int32),
+            np.asarray(fed[key][1], np.float32) if mode == "weighted" else None,
+        )
+        np.testing.assert_array_equal(got, want, err_msg=key)
+    # (c): crash now, recover, and every snapshot is bit-identical
+    before = {k: svc.snapshot(k) for k in open_keys}
+    seq = svc.sync()
+    del svc
+    gc.collect()
+    rec = ReservoirService.recover(ck)
+    assert rec.metrics.recoveries == 1
+    assert rec.flushed_seq == seq
+    assert sorted(s.key for s in rec.table.sessions()) == sorted(open_keys)
+    for key in open_keys:
+        np.testing.assert_array_equal(
+            rec.snapshot(key), before[key], err_msg=key
+        )
+    # recovered services keep serving: churn a fresh lease end to end
+    rec.open_session("post")
+    rec.ingest(
+        "post",
+        np.arange(99, dtype=np.int32),
+        weights=np.ones(99, np.float32) if mode == "weighted" else None,
+    )
+    assert rec.snapshot("post").size > 0
+
+
+def test_recovery_replays_resets_between_journaled_flushes(tmp_path):
+    """The ordering contract of the replay hook: a recycle reset AFTER the
+    last checkpoint must re-apply between the same journaled flushes it
+    originally fell between, or recovered reservoirs diverge."""
+    cfg = _cfg(num_reservoirs=2, max_sample_size=4, tile_size=8)
+    ck = str(tmp_path / "ck")
+    # checkpoint_every is huge: everything after the seq-0 anchor replays
+    # from the journal, resets included
+    svc = ReservoirService(cfg, key=5, checkpoint_dir=ck, checkpoint_every=1000)
+    svc.open_session("a")
+    svc.open_session("b")
+    svc.ingest("a", np.arange(100, 130, dtype=np.int32))
+    svc.close_session("a")
+    svc.open_session("c")  # reset of a's row lands mid-journal
+    svc.ingest("c", np.arange(500, 560, dtype=np.int32))
+    svc.ingest("b", np.arange(900, 930, dtype=np.int32))
+    before_b, before_c = svc.snapshot("b"), svc.snapshot("c")
+    svc.sync()
+    del svc
+    gc.collect()
+    rec = ReservoirService.recover(ck)
+    np.testing.assert_array_equal(rec.snapshot("b"), before_b)
+    np.testing.assert_array_equal(rec.snapshot("c"), before_c)
+    sess = rec.table.route("c")
+    assert sess.generation == 1  # the recycle survived recovery
+    want = _oracle_replay(
+        cfg, 5, rec.table, sess, np.arange(500, 560, dtype=np.int32)
+    )
+    np.testing.assert_array_equal(rec.snapshot("c"), want)
+
+
+# ------------------------------------------------------------------- soak
+
+
+def test_soak_10k_sessions_open_ingest_snapshot_evict_reopen(tmp_path):
+    """The acceptance soak: >= 10k concurrent sessions (CPU backend,
+    scaled-down k) through open/ingest/snapshot/evict/reopen with zero
+    cross-session leakage, oracle-bit-identical snapshot reads, and
+    ``recover()`` restoring the session table after a mid-soak kill.
+
+    ``RESERVOIR_SERVE_SOAK_SESSIONS`` scales the session count (the
+    tpu_watch ``serve_soak`` post-step runs it at the default)."""
+    S = int(os.environ.get("RESERVOIR_SERVE_SOAK_SESSIONS", "10240"))
+    k, B, per = 2, 8, 6
+    cfg = SamplerConfig(
+        max_sample_size=k, num_reservoirs=S, tile_size=B
+    )
+    ck = str(tmp_path / "ck")
+    svc = ReservoirService(
+        cfg, key=77, checkpoint_dir=ck, checkpoint_every=8,
+        coalesce_bytes=1 << 18,
+    )
+    rng = np.random.default_rng(7)
+    fed = {}
+
+    def feed(key, i):
+        elems = (i * 1000 + rng.integers(0, 1000, per)).astype(np.int64)
+        svc.ingest(key, elems)
+        fed.setdefault(key, []).extend(
+            np.asarray(elems, np.int32).tolist()
+        )
+
+    # phase 1: open + ingest 10k concurrent sessions
+    for i in range(S):
+        key = f"u{i}"
+        svc.open_session(key)
+        feed(key, i)
+    assert svc.metrics.sessions_open == S
+    svc.sync()
+    # whole-table leakage check, vectorized: every stored sample of row r
+    # belongs to session u_r's value range
+    samples, sizes = svc.bridge.engine.peek_arrays()
+    owner = np.repeat(np.arange(S), k).reshape(S, k)
+    valid = np.arange(k)[None, :] < sizes[:, None]
+    assert np.all((samples // 1000 == owner) | ~valid), "cross-session leakage"
+    # phase 2: evict a slice, reopen new tenants on the recycled rows.
+    # Closes first, then opens, then feeds: each recycle-open syncs before
+    # its row reset, and interleaving feeds would turn every one of the
+    # 512 syncs into a near-empty whole-table tile flush (and recovery
+    # would replay each) — pure soak runtime, no extra coverage.
+    n_churn = 512
+    for i in range(n_churn):
+        svc.close_session(f"u{i}")
+    churn_keys = [f"v{i}" for i in range(n_churn)]
+    for key in churn_keys:
+        svc.open_session(key)  # recycled rows: generation 1 + reset
+    for i, key in enumerate(churn_keys):
+        feed(key, S + i)
+    assert svc.metrics.recycles == n_churn
+    svc.sync()
+    # phase 3: snapshot reads — oracle-bit-identical on a sampled subset
+    # (each oracle is a fresh 1-row replay; all 10k would be pure runtime)
+    probe = [f"v{i}" for i in rng.integers(0, n_churn, 8)] + [
+        f"u{i}" for i in rng.integers(n_churn, S, 8)
+    ]
+    for key in dict.fromkeys(probe):
+        got = svc.snapshot(key)
+        sess = svc.table.route(key)
+        want = _oracle_replay(
+            cfg, 77, svc.table, sess, np.asarray(fed[key], np.int32)
+        )
+        np.testing.assert_array_equal(got, want, err_msg=key)
+    # mid-soak kill: no shutdown, no complete — the crash contract
+    n_open = svc.metrics.sessions_open
+    seq = svc.sync()
+    leases = {s.key: (s.row, s.generation) for s in svc.table.sessions()}
+    probe_before = {key: svc.snapshot(key) for key in dict.fromkeys(probe)}
+    del svc
+    gc.collect()
+    rec = ReservoirService.recover(ck)
+    assert rec.flushed_seq == seq
+    assert rec.metrics.sessions_open == n_open
+    assert {
+        s.key: (s.row, s.generation) for s in rec.table.sessions()
+    } == leases
+    for key, want in probe_before.items():
+        np.testing.assert_array_equal(rec.snapshot(key), want, err_msg=key)
+    # and the recovered plane still serves: one more churn cycle
+    rec.close_session("v0")
+    rec.open_session("w0")
+    feed_key = np.arange(4, dtype=np.int32)
+    rec.ingest("w0", feed_key)
+    assert rec.snapshot("w0").size > 0

@@ -364,6 +364,22 @@ def test_recovery_rehearsal_post_step_registered():
     )
 
 
+def test_serve_soak_post_step_registered():
+    # the ISSUE-4 serving-plane soak: budget-capped, runs the 10k-session
+    # open/ingest/snapshot/evict/reopen suite on the native backend, ahead
+    # of recovery_rehearsal (which stays last)
+    steps = {name: (cmd, timeout, env) for name, cmd, timeout, env in
+             tpu_watch.POST_STEPS}
+    cmd, timeout, env = steps["serve_soak"]
+    assert "tests/test_serve.py" in cmd
+    assert "-k" in cmd and "soak" in cmd
+    assert 0 < timeout <= 900
+    assert env.get("RESERVOIR_TPU_TEST_PLATFORM") == "native"
+    # and the serve bench config rides the default capture queue, budgeted
+    assert "serve" in tpu_watch.DEFAULT_CONFIGS.split(",")
+    assert "serve" in tpu_watch.CONFIG_BUDGETS
+
+
 def test_capture_surfaces_fault_counters(tmp_path, monkeypatch):
     # a bridge evidence row carrying robustness counters must lift them to
     # the capture row's top level, like the tuned geometry
@@ -438,7 +454,7 @@ def test_post_step_rehearsal_sequential_gating(tmp_path, monkeypatch):
     assert any("--kernel weighted" in r for r in ran)
     assert [s[0] for s in remaining] == [
         "distinct_sweep", "pallas_device_tests", "algl_best_block",
-        "recovery_rehearsal",
+        "serve_soak", "recovery_rehearsal",
     ]
     assert committed == ["2 post-step(s) recorded"]
     rows = [

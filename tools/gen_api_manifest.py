@@ -61,6 +61,9 @@ PUBLIC_MODULES = [
     "reservoir_tpu.parallel.merge",
     "reservoir_tpu.parallel.multihost",
     "reservoir_tpu.parallel.sharded",
+    "reservoir_tpu.serve",
+    "reservoir_tpu.serve.service",
+    "reservoir_tpu.serve.sessions",
     "reservoir_tpu.stream",
     "reservoir_tpu.stream.bridge",
     "reservoir_tpu.stream.interop",

@@ -75,6 +75,9 @@ CONFIG_BUDGETS: dict[str, tuple[float, dict[str, str]]] = {
     "bridge": (420.0, {"RESERVOIR_BENCH_SELFTEST": "0"}),
     "bridge_serial": (420.0, {"RESERVOIR_BENCH_SELFTEST": "0"}),
     "transfer": (240.0, {"RESERVOIR_BENCH_SELFTEST": "0"}),
+    # the ISSUE-4 serving plane: sessions/sec + live-snapshot latency on
+    # the real backend; host-path config, so no embedded parity selftest
+    "serve": (420.0, {"RESERVOIR_BENCH_SELFTEST": "0"}),
 }
 
 # r5 priority order (VERDICT r4): parity-attached headline first, then
@@ -84,7 +87,7 @@ CONFIG_BUDGETS: dict[str, tuple[float, dict[str, str]]] = {
 # a CONFIG_BUDGETS row (an unbudgeted config can burn a whole window).
 DEFAULT_CONFIGS = (
     "algl,algl_chunk1024,algl_chunk0,distinct,weighted,stream,bridge,"
-    "bridge_serial,algl_B4096"
+    "bridge_serial,serve,algl_B4096"
 )
 
 def _now() -> str:
@@ -374,6 +377,25 @@ POST_STEPS: list[tuple[str, list[str], float, dict]] = [
         [sys.executable, os.path.join(REPO, "tools", "tpu_best_block.py")],
         2700.0,
         {},
+    ),
+    (
+        # serving-plane soak (ISSUE 4): >= 10k concurrent sessions through
+        # open/ingest/snapshot/evict/reopen on the native backend, with
+        # oracle-bit-identical snapshots and a mid-soak kill + recover —
+        # budget-capped so a wedged run costs minutes of window, not all
+        "serve_soak",
+        [
+            sys.executable,
+            "-m",
+            "pytest",
+            "tests/test_serve.py",
+            "-q",
+            "--no-header",
+            "-k",
+            "soak",
+        ],
+        900.0,
+        {"RESERVOIR_TPU_TEST_PLATFORM": "native"},
     ),
     (
         # robustness rehearsal (ISSUE 3): auto-checkpoint, kill the bridge
