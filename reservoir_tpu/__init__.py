@@ -27,6 +27,8 @@ from .config import (
 from .errors import (
     AbruptStreamTermination,
     CheckpointCorrupt,
+    CheckpointMismatch,
+    FencedError,
     FlushTimeout,
     RetryPolicy,
     SamplerClosedError,
@@ -56,7 +58,15 @@ def __getattr__(name):
         from . import stream
 
         return getattr(stream, name)
-    if name in ("ReservoirService", "SessionTable", "Session"):
+    if name in (
+        "ReservoirService",
+        "SessionTable",
+        "Session",
+        "StandbyReplica",
+        "JournalFollower",
+        "FailoverController",
+        "HeartbeatWriter",
+    ):
         from . import serve
 
         return getattr(serve, name)
@@ -73,6 +83,8 @@ __all__ = [
     "TransientDeviceError",
     "FlushTimeout",
     "CheckpointCorrupt",
+    "CheckpointMismatch",
+    "FencedError",
     "RetryPolicy",
     "UnknownSessionError",
     "StaleSessionError",
@@ -88,5 +100,9 @@ __all__ = [
     "ReservoirService",
     "SessionTable",
     "Session",
+    "StandbyReplica",
+    "JournalFollower",
+    "FailoverController",
+    "HeartbeatWriter",
     "__version__",
 ]

@@ -48,6 +48,20 @@ from .utils import faults as _faults
 
 __all__ = ["ReservoirEngine"]
 
+# Cross-engine jit caches (ISSUE 5).  A warm standby bootstrap, a crash
+# recovery, or a 1-row oracle replay constructs a FRESH engine whose first
+# tile used to pay a full XLA re-trace+compile identical to one another
+# engine of the same mode had already compiled — ~1s per engine on the CPU
+# backend, the dominant cost of "warm" failover.  The traced computation
+# is fully determined by (ops module, fill/steady regime) when there is no
+# custom map_fn/hash_fn and no mesh (shapes/dtypes are jit's own cache
+# axes), and by (ops module, batch size, k, dtypes) for row resets — share
+# those jitted callables process-wide.  Pallas, meshed, and custom-fn
+# engines keep per-instance caching (their traces close over instance
+# state or arbitrary callables).
+_SHARED_UPDATE_JIT: dict = {}
+_SHARED_RESET_JIT: dict = {}
+
 
 class ReservoirEngine:
     """R independent k-reservoirs updated in lockstep on device.
@@ -485,10 +499,24 @@ class ReservoirEngine:
                 geometry = None
                 self._log_ignored_geometry(width, tile_dtype, steady, ragged)
             self._geometry_by_key[cache_key] = geometry
-            fn = jax.jit(
-                self._base_update(steady, use_pallas, geometry),
-                donate_argnums=(0,),
-            )
+            shared_key = None
+            if (
+                not use_pallas
+                and self._mesh is None
+                and self._map_fn is None
+                and self._hash_fn is None
+            ):
+                # _base_update is then a partial over the ops module alone
+                # (shapes/dtypes/raggedness are jit's own cache axes)
+                shared_key = (self._ops, steady)
+                fn = _SHARED_UPDATE_JIT.get(shared_key)
+            if fn is None:
+                fn = jax.jit(
+                    self._base_update(steady, use_pallas, geometry),
+                    donate_argnums=(0,),
+                )
+                if shared_key is not None:
+                    _SHARED_UPDATE_JIT[shared_key] = fn
             self._jit_cache[cache_key] = fn
         return fn
 
@@ -965,17 +993,27 @@ class ReservoirEngine:
                 else jnp.dtype(self._config.count_dtype)
             )
             ops = self._ops
+            shared_key = (
+                (ops, n, k, sample_dtype, count_dtype)
+                if self._mesh is None
+                else None
+            )
+            if shared_key is not None:
+                fn = _SHARED_RESET_JIT.get(shared_key)
+            if fn is None:
 
-            def reset(state, reset_key, idx):
-                part = ops.init(
-                    reset_key, n, k,
-                    sample_dtype=sample_dtype, count_dtype=count_dtype,
-                )
-                return jax.tree.map(
-                    lambda full, one: full.at[idx].set(one), state, part
-                )
+                def reset(state, reset_key, idx):
+                    part = ops.init(
+                        reset_key, n, k,
+                        sample_dtype=sample_dtype, count_dtype=count_dtype,
+                    )
+                    return jax.tree.map(
+                        lambda full, one: full.at[idx].set(one), state, part
+                    )
 
-            fn = jax.jit(reset, donate_argnums=(0,))
+                fn = jax.jit(reset, donate_argnums=(0,))
+                if shared_key is not None:
+                    _SHARED_RESET_JIT[shared_key] = fn
             self._reset_jit[rows.size] = fn
         idx = rows
         if self._mesh is not None:

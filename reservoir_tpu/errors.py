@@ -74,6 +74,29 @@ class CheckpointCorrupt(RuntimeError):
     raw numpy/zipfile internals."""
 
 
+class CheckpointMismatch(CheckpointCorrupt):
+    """A checkpoint is internally consistent but cannot be restored *here*:
+    its state arrays disagree with its recorded config, or its config needs
+    a backend this process does not have (e.g. a meshed engine whose
+    reservoir count does not divide the live device count).  Raised by the
+    recovery pre-flight (``load_engine`` / ``recover``) with the mismatch
+    named, instead of an opaque shape error deep inside XLA."""
+
+
+class FencedError(RuntimeError):
+    """A write was refused because a newer primary epoch is persisted in the
+    checkpoint directory: this process was fenced by a failover promotion
+    (``StandbyReplica.promote``) and must not touch the durable state again
+    — split-brain protection for the HA plane.  ``observed_epoch`` is the
+    persisted epoch, ``own_epoch`` the one this writer was admitted at."""
+
+    def __init__(self, message: str, observed_epoch: int = 0,
+                 own_epoch: int = 0) -> None:
+        super().__init__(message)
+        self.observed_epoch = observed_epoch
+        self.own_epoch = own_epoch
+
+
 class UnknownSessionError(KeyError):
     """A session key is not (or no longer) leased in the serving plane's
     :class:`~reservoir_tpu.serve.sessions.SessionTable` — never opened,

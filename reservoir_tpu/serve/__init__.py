@@ -16,10 +16,33 @@ one-shot reads.  The serve layer adds the missing multiplexing plane:
   interleaved tile path, admission control (bounded in-flight bytes,
   reject-with-retry-after), live non-destructive snapshot queries served
   from a ``flushed_seq``-keyed device->host cache, and crash recovery that
-  rebuilds the session table from a journaled session map.
+  rebuilds the session table from a journaled session map;
+- :mod:`.replica` / :mod:`.ha` — the high-availability plane (ISSUE 5): a
+  :class:`~reservoir_tpu.serve.replica.StandbyReplica` tails the primary's
+  flush journal into a warm, bit-identical replica
+  (:class:`~reservoir_tpu.serve.replica.JournalFollower` is the resumable
+  CRC-checked byte-cursor tail), and a
+  :class:`~reservoir_tpu.serve.ha.FailoverController` watches the
+  primary's heartbeat/health signals
+  (:class:`~reservoir_tpu.serve.ha.HeartbeatWriter`) and performs
+  **epoch-fenced** promotion — the fenced old primary fails its next
+  durable write with :class:`~reservoir_tpu.errors.FencedError` instead
+  of double-serving.
 """
 
+from .ha import FailoverController, HealthReport, HeartbeatWriter, read_heartbeat
+from .replica import JournalFollower, StandbyReplica
 from .service import ReservoirService
 from .sessions import Session, SessionTable
 
-__all__ = ["ReservoirService", "Session", "SessionTable"]
+__all__ = [
+    "ReservoirService",
+    "Session",
+    "SessionTable",
+    "StandbyReplica",
+    "JournalFollower",
+    "FailoverController",
+    "HeartbeatWriter",
+    "HealthReport",
+    "read_heartbeat",
+]

@@ -1,0 +1,282 @@
+"""Failover control: heartbeats, health verdicts, epoch-fenced promotion.
+
+The replication half of the HA plane lives in
+:mod:`reservoir_tpu.serve.replica`; this module decides *when* to use it
+and makes using it safe:
+
+- :class:`HeartbeatWriter` — the primary's liveness beacon: an atomic
+  ``heartbeat.json`` in the checkpoint dir carrying a timestamp, the
+  writer's epoch, the durable flush watermark, and the health signals the
+  stack already emits (``BridgeMetrics.watchdog_trips``/``demotions``/
+  ``failures``, ``ServiceMetrics.rejections`` — the
+  :class:`~reservoir_tpu.errors.ServiceSaturated` pressure counter).  A
+  fenced writer (newer persisted epoch) refuses to beat, so a zombie
+  primary cannot keep claiming liveness.
+- :class:`FailoverController` — the standby-side health model over those
+  signals: heartbeat staleness (the crash/hang detector), watchdog trips
+  (the flush pipeline is wedged — the one bridge failure ``recover()``
+  cannot ride out in place), and optional demotion/rejection thresholds.
+  :meth:`FailoverController.maybe_promote` turns an unhealthy verdict
+  into :meth:`StandbyReplica.promote` — which bumps the **epoch**
+  persisted next to the checkpoint (fsynced, atomic), the fence every
+  journaling writer checks before each flush/checkpoint: the old primary
+  fails its next durable write with a typed
+  :class:`~reservoir_tpu.errors.FencedError` instead of double-serving
+  rows the promoted primary now owns.
+
+Fault plane: the ``ha.heartbeat`` site fires on every beat *and* every
+controller read — an injected writer fault lets the file go stale (the
+controller then promotes), an injected reader fault is treated as a
+missing heartbeat (stale after the timeout).  Both are pinned by
+``tests/test_faults.py`` / ``tests/test_ha.py``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import tempfile
+import time
+from typing import Any, List, Optional
+
+from ..errors import FencedError
+from ..utils import faults as _faults
+from ..utils.checkpoint import read_epoch
+from ..utils.metrics import HAMetrics
+
+__all__ = [
+    "HeartbeatWriter",
+    "read_heartbeat",
+    "HealthReport",
+    "FailoverController",
+]
+
+_HEARTBEAT_NAME = "heartbeat.json"
+
+
+def read_heartbeat(checkpoint_dir: str) -> Optional[dict]:
+    """The last heartbeat payload, or ``None`` when missing/unreadable (a
+    torn/corrupt heartbeat is indistinguishable from a dead primary, and
+    is treated exactly that way: stale)."""
+    try:
+        with open(
+            os.path.join(checkpoint_dir, _HEARTBEAT_NAME), encoding="utf-8"
+        ) as fh:
+            return json.load(fh)
+    except (FileNotFoundError, OSError, json.JSONDecodeError, ValueError):
+        return None
+
+
+class HeartbeatWriter:
+    """The primary's liveness beacon.
+
+    Call :meth:`beat` on a cadence (each sync, a timer thread, the ingest
+    loop — anything faster than the controller's
+    ``heartbeat_timeout_s``).  Each beat is an atomic temp-file + rename
+    (readers never see a torn payload) and carries the signals the
+    controller's health model consumes.  A writer admitted at epoch E
+    refuses to beat once the persisted epoch exceeds E
+    (:class:`FencedError`, counted in ``metrics.fenced_writes``) — a
+    fenced zombie must look dead, not alive.
+    """
+
+    def __init__(
+        self,
+        checkpoint_dir: str,
+        service: Optional[Any] = None,
+        bridge: Optional[Any] = None,
+        *,
+        clock=time.time,
+        faults: Optional[Any] = None,
+        metrics: Optional[HAMetrics] = None,
+    ) -> None:
+        self._dir = checkpoint_dir
+        self._svc = service
+        self._bridge = bridge if bridge is not None else (
+            service.bridge if service is not None else None
+        )
+        self._clock = clock
+        self._faults = faults
+        self._metrics = metrics if metrics is not None else HAMetrics()
+        self._epoch = read_epoch(checkpoint_dir)
+
+    @property
+    def epoch(self) -> int:
+        return self._epoch
+
+    @property
+    def metrics(self) -> HAMetrics:
+        return self._metrics
+
+    def beat(self) -> dict:
+        """Write one heartbeat; returns the payload written."""
+        _faults.fire("ha.heartbeat", self._faults)
+        current = read_epoch(self._dir)
+        if current > self._epoch:
+            self._metrics.fenced_writes += 1
+            raise FencedError(
+                f"heartbeat fenced: {self._dir!r} is at primary epoch "
+                f"{current}, this writer was admitted at {self._epoch}",
+                observed_epoch=current,
+                own_epoch=self._epoch,
+            )
+        payload: dict = {"ts": float(self._clock()), "epoch": self._epoch}
+        if self._bridge is not None:
+            m = self._bridge.metrics
+            payload.update(
+                seq=int(self._bridge.flushed_seq),
+                watchdog_trips=m.watchdog_trips,
+                demotions=m.demotions,
+                failures=m.failures,
+            )
+        if self._svc is not None:
+            payload["rejections"] = self._svc.metrics.rejections
+            payload["sessions_open"] = self._svc.metrics.sessions_open
+        fd, tmp = tempfile.mkstemp(dir=self._dir, suffix=".tmp.hb")
+        try:
+            with os.fdopen(fd, "w", encoding="utf-8") as fh:
+                json.dump(payload, fh)
+            os.replace(tmp, os.path.join(self._dir, _HEARTBEAT_NAME))
+        except BaseException:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+            raise
+        self._metrics.heartbeats += 1
+        return payload
+
+
+@dataclasses.dataclass
+class HealthReport:
+    """One controller verdict.  ``should_promote`` is the actionable bit;
+    ``reasons`` name every signal that contributed (promote-worthy ones
+    first), ``heartbeat_age_s`` the observed staleness (``None`` before
+    the first check can age anything)."""
+
+    healthy: bool
+    should_promote: bool
+    reasons: List[str]
+    heartbeat_age_s: Optional[float]
+    heartbeat: Optional[dict]
+
+
+class FailoverController:
+    """Standby-side failover decision over the primary's emitted signals.
+
+    Args:
+      standby: the :class:`~reservoir_tpu.serve.replica.StandbyReplica`
+        to promote (shares its :class:`HAMetrics`).
+      heartbeat_timeout_s: staleness past which the primary is presumed
+        dead/hung.  A missing heartbeat ages from this controller's first
+        health check (a primary that never once beat is equally dead).
+      max_watchdog_trips: heartbeat-reported ``watchdog_trips`` above this
+        promote (default 0: one tripped flush watchdog means the primary's
+        pipeline is wedged inside the runtime — the failure mode in-place
+        recovery cannot fix).
+      max_demotions / max_rejections: optional promote thresholds for the
+        degraded-but-alive signals (Pallas->XLA demotions, admission-
+        control rejections).  ``None`` (default) records them as degraded
+        health without promoting — a slow primary is still a primary.
+      clock: time source matching the writer's (``time.time`` default).
+    """
+
+    def __init__(
+        self,
+        standby: Any,
+        *,
+        heartbeat_timeout_s: float = 5.0,
+        max_watchdog_trips: int = 0,
+        max_demotions: Optional[int] = None,
+        max_rejections: Optional[int] = None,
+        clock=time.time,
+        faults: Optional[Any] = None,
+    ) -> None:
+        self._standby = standby
+        self._dir = standby.checkpoint_dir
+        self._timeout = float(heartbeat_timeout_s)
+        self._max_watchdog = int(max_watchdog_trips)
+        self._max_demotions = max_demotions
+        self._max_rejections = max_rejections
+        self._clock = clock
+        self._faults = faults
+        self._metrics = standby.metrics
+        self._first_check_t: Optional[float] = None
+        self.last_promotion_reason: Optional[str] = None
+
+    @property
+    def metrics(self) -> HAMetrics:
+        return self._metrics
+
+    def health(self) -> HealthReport:
+        """Evaluate the primary's health from its emitted signals."""
+        now = self._clock()
+        if self._first_check_t is None:
+            self._first_check_t = now
+        promote: List[str] = []
+        degraded: List[str] = []
+        hb: Optional[dict] = None
+        try:
+            _faults.fire("ha.heartbeat", self._faults)
+            hb = read_heartbeat(self._dir)
+        except Exception as e:
+            degraded.append(
+                f"heartbeat read failed ({type(e).__name__}: {e})"
+            )
+        if hb is None:
+            age = now - self._first_check_t
+            if age > self._timeout:
+                promote.append(
+                    f"no heartbeat for {age:.1f}s "
+                    f"(timeout {self._timeout:g}s)"
+                )
+        else:
+            age = now - float(hb.get("ts", 0.0))
+            if age > self._timeout:
+                promote.append(
+                    f"heartbeat stale ({age:.1f}s > {self._timeout:g}s)"
+                )
+            trips = int(hb.get("watchdog_trips", 0))
+            if trips > self._max_watchdog:
+                promote.append(
+                    f"flush watchdog tripped {trips}x (pipeline wedged)"
+                )
+            demotions = int(hb.get("demotions", 0))
+            if self._max_demotions is not None and (
+                demotions > self._max_demotions
+            ):
+                promote.append(f"{demotions} Pallas->XLA demotions")
+            elif demotions:
+                degraded.append(f"degraded: {demotions} demotions")
+            rejections = int(hb.get("rejections", 0))
+            if self._max_rejections is not None and (
+                rejections > self._max_rejections
+            ):
+                promote.append(
+                    f"{rejections} admission rejections (saturated)"
+                )
+            elif rejections:
+                degraded.append(f"degraded: {rejections} rejections")
+        return HealthReport(
+            healthy=not promote and not degraded,
+            should_promote=bool(promote),
+            reasons=promote + degraded,
+            heartbeat_age_s=age,
+            heartbeat=hb,
+        )
+
+    def maybe_promote(self) -> Optional[Any]:
+        """One control-loop step: promote iff the health verdict says so.
+        Returns the promoted service, or ``None`` (primary healthy/only
+        degraded)."""
+        report = self.health()
+        if not report.should_promote:
+            return None
+        return self.promote(reason="; ".join(report.reasons) or "unhealthy")
+
+    def promote(self, reason: str = "manual") -> Any:
+        """Force the failover (epoch fence + tail drain + flip); returns
+        the promoted service.  ``promotions`` counts on the shared
+        metrics (inside ``StandbyReplica.promote``)."""
+        service = self._standby.promote()
+        self.last_promotion_reason = reason
+        return service

@@ -53,6 +53,7 @@ import numpy as np
 
 from ..config import SamplerConfig
 from ..errors import (
+    CheckpointMismatch,
     RetryPolicy,
     ServiceSaturated,
     SessionIngestError,
@@ -113,12 +114,20 @@ class ReservoirService:
         with :class:`ServiceSaturated`.
       retry_after_s: floor of the rejection's retry hint (the live hint
         scales with the observed per-flush dispatch time).
+      sweep_interval_s: opportunistic TTL-sweep cadence.  When set (and
+        ``ttl_s`` is), every :meth:`ingest` / :meth:`snapshot` /
+        :meth:`sync` first evicts TTL-expired sessions if at least this
+        many seconds passed since the last sweep — an idle-but-queried
+        service sheds expired leases without anyone calling
+        :meth:`sweep_expired` manually.  ``None`` (default) keeps sweeps
+        manual-only.
       pipelined / retry_policy / flush_timeout_s / checkpoint_dir /
-        checkpoint_every / faults: forwarded to the underlying
-        :class:`DeviceStreamBridge` (the ISSUE-3 robustness plane).  With
-        ``checkpoint_dir`` set the service additionally journals the
-        session map to ``sessions.jsonl`` there, which is what makes
-        :meth:`recover` possible.
+        checkpoint_every / durability / faults: forwarded to the
+        underlying :class:`DeviceStreamBridge` (the ISSUE-3/5 robustness
+        plane).  With ``checkpoint_dir`` set the service additionally
+        journals the session map to ``sessions.jsonl`` there, which is
+        what makes :meth:`recover` (and hot-standby replication,
+        :class:`~reservoir_tpu.serve.replica.StandbyReplica`) possible.
     """
 
     def __init__(
@@ -131,11 +140,13 @@ class ReservoirService:
         coalesce_bytes: int = 1 << 16,
         max_inflight_bytes: int = 1 << 24,
         retry_after_s: float = 0.05,
+        sweep_interval_s: Optional[float] = None,
         pipelined: bool = True,
         retry_policy: Optional[RetryPolicy] = None,
         flush_timeout_s: Optional[float] = None,
         checkpoint_dir: Optional[str] = None,
         checkpoint_every: int = 64,
+        durability: str = "buffered",
         faults: Optional[Any] = None,
         _bridge: Optional[DeviceStreamBridge] = None,
         _table: Optional[SessionTable] = None,
@@ -159,6 +170,7 @@ class ReservoirService:
             flush_timeout_s=flush_timeout_s,
             checkpoint_dir=checkpoint_dir,
             checkpoint_every=checkpoint_every,
+            durability=durability,
             faults=faults,
         )
         config = self._bridge._config
@@ -170,6 +182,10 @@ class ReservoirService:
         self._coalesce_bytes = int(coalesce_bytes)
         self._max_inflight_bytes = int(max_inflight_bytes)
         self._retry_after_s = float(retry_after_s)
+        self._sweep_interval_s = (
+            float(sweep_interval_s) if sweep_interval_s is not None else None
+        )
+        self._last_sweep = self._table._clock()
         self._metrics = ServiceMetrics()
         self._metrics.sessions_open = len(self._table)
         # pending cross-session coalesce buffer: (rows, elems, weights)
@@ -297,6 +313,17 @@ class ReservoirService:
         self._metrics.sessions_open = len(self._table)
         return final
 
+    def _maybe_sweep(self) -> None:
+        """Opportunistic TTL sweep (ISSUE-5 satellite): ingest/snapshot/
+        sync call this first, so an idle-but-queried service still sheds
+        expired leases on its own once ``sweep_interval_s`` elapses."""
+        if self._sweep_interval_s is None or self._table.ttl_s is None:
+            return
+        now = self._table._clock()
+        if now - self._last_sweep >= self._sweep_interval_s:
+            self._last_sweep = now
+            self.sweep_expired(now)
+
     def sweep_expired(self, now: Optional[float] = None) -> List[str]:
         """Evict every TTL-expired session; returns their keys."""
         evicted = self._table.sweep(now)
@@ -326,6 +353,7 @@ class ReservoirService:
         The elements join the cross-session coalesce buffer and ship
         through the bridge's interleaved demux once ``coalesce_bytes``
         accumulate (or at the next sync/snapshot barrier)."""
+        self._maybe_sweep()
         sess = self._table.route(key)
         try:
             _faults.fire("serve.ingest", self._faults)
@@ -432,6 +460,7 @@ class ReservoirService:
         pipeline.  Returns the durable ``flushed_seq`` watermark — after
         sync, every accepted element is journaled/applied and visible to
         snapshots."""
+        self._maybe_sweep()
         self._flush_pending()
         self._bridge.flush()
         self._bridge.drain_barrier()
@@ -452,6 +481,7 @@ class ReservoirService:
         Reads are served from a whole-table device->host snapshot cache
         keyed by ``(flushed_seq, reset_epoch)``: N sessions polling between
         flushes cost ONE device readback, not N."""
+        self._maybe_sweep()
         sess = self._table.route(key)
         self._table.check(sess)  # generation guard: no stale-row reads
         if sync:
@@ -480,10 +510,12 @@ class ReservoirService:
         coalesce_bytes: int = 1 << 16,
         max_inflight_bytes: int = 1 << 24,
         retry_after_s: float = 0.05,
+        sweep_interval_s: Optional[float] = None,
         pipelined: Optional[bool] = None,
         retry_policy: Optional[RetryPolicy] = None,
         flush_timeout_s: Optional[float] = None,
         checkpoint_every: Optional[int] = None,
+        durability: Optional[str] = None,
         faults: Optional[Any] = None,
     ) -> "ReservoirService":
         """Rebuild a crashed service from ``checkpoint_dir``.
@@ -557,15 +589,26 @@ class ReservoirService:
             retry_policy=retry_policy,
             flush_timeout_s=flush_timeout_s,
             checkpoint_every=checkpoint_every,
+            durability=durability,
             faults=faults,
             replay_hook=replay_hook,
         )
+        if bridge._config.num_reservoirs != table.capacity:
+            # recovery pre-flight (ISSUE-5 satellite): the two journals
+            # must describe the SAME plane — a swapped/stale sessions.jsonl
+            # would otherwise lease rows the engine does not have
+            raise CheckpointMismatch(
+                f"session journal in {checkpoint_dir!r} leases "
+                f"{table.capacity} rows, but the engine checkpoint has "
+                f"num_reservoirs={bridge._config.num_reservoirs}"
+            )
         service = cls(
             bridge._config,
             ttl_s=ttl_s,
             coalesce_bytes=coalesce_bytes,
             max_inflight_bytes=max_inflight_bytes,
             retry_after_s=retry_after_s,
+            sweep_interval_s=sweep_interval_s,
             faults=faults,
             checkpoint_dir=checkpoint_dir,
             _bridge=bridge,

@@ -58,12 +58,14 @@ from ..config import SamplerConfig
 from ..engine import ReservoirEngine
 from ..errors import (
     AbruptStreamTermination,
+    FencedError,
     FlushTimeout,
     RetryPolicy,
     SamplerClosedError,
 )
 from ..native import NativeStaging
 from ..utils import faults as _faults
+from ..utils.checkpoint import read_epoch
 from ..utils.metrics import BridgeMetrics
 from ..utils.tracing import trace_span
 
@@ -297,20 +299,39 @@ class _FlushJournal:
     records also carry ``seq`` so a crash *between* checkpoint write and
     rotation is safe: recovery filters out records the checkpoint already
     covers instead of double-applying them.
+
+    Durability (ISSUE 5 satellite): ``fsync=True`` additionally fsyncs
+    every appended frame (and the file+directory on rotation), closing the
+    OS/power-crash window the buffered default concedes above — at the
+    cost of one fsync per flush, counted through ``sync_cb``.
     """
 
     _MAGIC = b"RTJL"
     _HEADER = struct.Struct("<4sQI")
 
     def __init__(
-        self, path: str, num_streams: int, tile_width: int, dtype, weighted: bool
+        self,
+        path: str,
+        num_streams: int,
+        tile_width: int,
+        dtype,
+        weighted: bool,
+        fsync: bool = False,
+        sync_cb=None,
     ) -> None:
         self._path = path
         self._S = int(num_streams)
         self._B = int(tile_width)
         self._dtype = np.dtype(dtype)
         self._weighted = weighted
+        self._fsync = bool(fsync)
+        self._sync_cb = sync_cb
         self._fh = open(path, "ab")
+
+    def _sync(self) -> None:
+        os.fsync(self._fh.fileno())
+        if self._sync_cb is not None:
+            self._sync_cb()
 
     def append(
         self,
@@ -326,22 +347,48 @@ class _FlushJournal:
         self._fh.write(payload)
         self._fh.write(struct.pack("<I", zlib.crc32(payload)))
         self._fh.flush()
+        if self._fsync:
+            self._sync()
 
     def rotate(self) -> None:
         """Drop every record (a fresh checkpoint now covers them)."""
         self._fh.seek(0)
         self._fh.truncate()
         self._fh.flush()
+        if self._fsync:
+            self._sync()
+            # the directory too: the truncation must not resurrect stale
+            # records after a power crash once the checkpoint replaced them
+            dir_fd = os.open(os.path.dirname(self._path) or ".", os.O_RDONLY)
+            try:
+                os.fsync(dir_fd)
+            finally:
+                os.close(dir_fd)
+            if self._sync_cb is not None:
+                self._sync_cb()
 
     def close(self) -> None:
         self._fh.close()
 
     @classmethod
-    def replay(
-        cls, path: str, num_streams: int, tile_width: int, dtype, weighted: bool
-    ) -> Iterator[Tuple[int, np.ndarray, np.ndarray, Optional[np.ndarray]]]:
-        """Yield ``(seq, tile, valid, wtile)`` for every intact record,
-        stopping cleanly at the first truncated/corrupt one."""
+    def read_records(
+        cls,
+        path: str,
+        num_streams: int,
+        tile_width: int,
+        dtype,
+        weighted: bool,
+        offset: int = 0,
+    ) -> Iterator[
+        Tuple[int, int, np.ndarray, np.ndarray, Optional[np.ndarray]]
+    ]:
+        """Yield ``(end_offset, seq, tile, valid, wtile)`` for every intact
+        record starting at byte ``offset``, stopping cleanly at the first
+        truncated/corrupt frame.  ``end_offset`` is the byte cursor AFTER
+        the yielded record — the resumable-tail API the HA plane's
+        :class:`~reservoir_tpu.serve.replica.JournalFollower` polls (a torn
+        tail is retried from its start offset on the next poll, never
+        treated as permanent corruption: the primary may be mid-append)."""
         dtype = np.dtype(dtype)
         S, B = int(num_streams), int(tile_width)
         n_valid = S * 4
@@ -352,6 +399,7 @@ class _FlushJournal:
         except FileNotFoundError:
             return
         with fh:
+            fh.seek(offset)
             while True:
                 head = fh.read(cls._HEADER.size)
                 if len(head) < cls._HEADER.size:
@@ -378,7 +426,18 @@ class _FlushJournal:
                     if weighted
                     else None
                 )
-                yield int(seq), tile, valid, wtile
+                yield fh.tell(), int(seq), tile, valid, wtile
+
+    @classmethod
+    def replay(
+        cls, path: str, num_streams: int, tile_width: int, dtype, weighted: bool
+    ) -> Iterator[Tuple[int, np.ndarray, np.ndarray, Optional[np.ndarray]]]:
+        """Yield ``(seq, tile, valid, wtile)`` for every intact record,
+        stopping cleanly at the first truncated/corrupt one."""
+        for _, seq, tile, valid, wtile in cls.read_records(
+            path, num_streams, tile_width, dtype, weighted
+        ):
+            yield seq, tile, valid, wtile
 
 
 class DeviceStreamBridge:
@@ -416,6 +475,13 @@ class DeviceStreamBridge:
         after a crash.  ``None`` (default) disables — the journal copy per
         flush is the durability cost, paid only when asked for.
       checkpoint_every: auto-checkpoint cadence in flushes (default 64).
+      durability: journal write discipline when ``checkpoint_dir`` is set.
+        ``"buffered"`` (the default) flushes each frame to the OS — a
+        process crash loses nothing, an OS/power crash may cost the tail
+        record (tolerated by replay).  ``"fsync"`` additionally fsyncs
+        every frame (and the directory on rotation), closing that window;
+        syncs are counted in ``metrics.journal_syncs`` (zero in buffered
+        mode, pinned by ``tests/test_ha.py``).
       faults: per-bridge :class:`~reservoir_tpu.utils.faults.FaultPlane`
         for the ``bridge.*``/``engine.*`` injection sites; ``None`` defers
         to the globally installed plane (``RESERVOIR_FAULTS``) — and when
@@ -436,9 +502,14 @@ class DeviceStreamBridge:
         flush_timeout_s: Optional[float] = None,
         checkpoint_dir: Optional[str] = None,
         checkpoint_every: int = 64,
+        durability: str = "buffered",
         faults: Optional[Any] = None,
         _engine: Optional[ReservoirEngine] = None,
     ) -> None:
+        if durability not in ("buffered", "fsync"):
+            raise ValueError(
+                f"durability must be 'buffered' or 'fsync', got {durability!r}"
+            )
         self._config = config
         self._faults = faults
         # _engine is the recovery path (recover() restores it from the
@@ -516,14 +587,24 @@ class DeviceStreamBridge:
         self._flush_seq = 0  # flushes journaled/checkpointed so far
         self._journal: Optional[_FlushJournal] = None
         self._ckpt_failed_logged = False
+        self._durability = durability
+        # HA fencing (ISSUE 5): the bridge is admitted at the epoch
+        # persisted in the checkpoint dir at construction; a later epoch
+        # bump (StandbyReplica.promote on another process/object) fences
+        # every subsequent flush/checkpoint with FencedError
+        self._epoch = 0
+        self._fence_cache: Tuple[Optional[Tuple[int, int]], int] = (None, 0)
         if checkpoint_dir is not None:
             os.makedirs(checkpoint_dir, exist_ok=True)
+            self._epoch = read_epoch(checkpoint_dir)
             self._journal = _FlushJournal(
                 os.path.join(checkpoint_dir, "journal.bin"),
                 S,
                 B,
                 dtype,
                 config.weighted,
+                fsync=durability == "fsync",
+                sync_cb=self._count_journal_sync,
             )
             if _engine is None:
                 # seq-0 anchor: recovery must be possible from flush one
@@ -668,6 +749,7 @@ class DeviceStreamBridge:
         """Bypass buffering: dispatch a pre-assembled ``[S, B]`` tile straight
         to the device (the zero-copy fast path for array-shaped sources)."""
         self._check_open()
+        self._check_fence()
         self._metrics.start()
         self.drain_barrier()  # engine is single-writer: wait out the worker
         tile = np.asarray(tile)
@@ -747,6 +829,9 @@ class DeviceStreamBridge:
         tile first.  Either way the next demux overlaps this flush's
         transfer+dispatch when pipelined.
         """
+        # fence BEFORE any staging drain or journal append: a fenced
+        # primary must fail fast with nothing mutated (ISSUE 5)
+        self._check_fence()
         if self._zero_copy:
             i = self._buf
             tile, valid = self._tiles[i], self._valids[i]
@@ -830,6 +915,80 @@ class DeviceStreamBridge:
         recoverable — they never left the producer's custody)."""
         return self._flush_seq
 
+    @property
+    def epoch(self) -> int:
+        """The primary epoch this bridge was admitted at (0 when it does
+        not checkpoint).  A newer epoch persisted in the checkpoint dir —
+        a failover promotion — fences this bridge: its next flush or
+        checkpoint raises :class:`~reservoir_tpu.errors.FencedError`
+        without touching the journal."""
+        return self._epoch
+
+    def _count_journal_sync(self) -> None:
+        self._metrics.journal_syncs += 1
+
+    def _current_epoch(self) -> int:
+        """The persisted epoch, stat-cached so the per-flush fence check
+        costs one stat when nothing changed (no read, no parse)."""
+        path = os.path.join(self._ckpt_dir, "epoch.json")
+        try:
+            st = os.stat(path)
+        except FileNotFoundError:
+            return 0
+        key = (st.st_mtime_ns, st.st_size)
+        if self._fence_cache[0] != key:
+            self._fence_cache = (key, read_epoch(self._ckpt_dir))
+        return self._fence_cache[1]
+
+    def _check_fence(self) -> None:
+        """Refuse durable writes once a newer primary epoch is persisted
+        (split-brain protection): raises BEFORE any journal/staging
+        mutation, so a fenced primary can never double-write a flush the
+        promoted primary also owns."""
+        if self._journal is None:
+            return
+        current = self._current_epoch()
+        if current > self._epoch:
+            self._metrics.fenced_writes += 1
+            raise FencedError(
+                f"bridge fenced: checkpoint dir {self._ckpt_dir!r} is at "
+                f"primary epoch {current}, this bridge was admitted at "
+                f"{self._epoch} — a standby was promoted; stop writing",
+                observed_epoch=current,
+                own_epoch=self._epoch,
+            )
+
+    def _attach_journal(
+        self,
+        checkpoint_dir: str,
+        *,
+        checkpoint_every: int = 64,
+        durability: str = "buffered",
+        epoch: Optional[int] = None,
+    ) -> None:
+        """Adopt ``checkpoint_dir`` as this bridge's durability plane — the
+        standby-promotion path (:meth:`StandbyReplica.promote`): opens the
+        journal for append WITHOUT the fresh-bridge seq-0 anchor (the
+        existing checkpoint+journal already cover ``flushed_seq``) and
+        admits the bridge at ``epoch`` (default: the persisted one)."""
+        if self._journal is not None:
+            raise ValueError("this bridge already journals")
+        os.makedirs(checkpoint_dir, exist_ok=True)
+        self._ckpt_dir = checkpoint_dir
+        self._ckpt_every = max(1, int(checkpoint_every))
+        self._durability = durability
+        self._epoch = read_epoch(checkpoint_dir) if epoch is None else epoch
+        self._fence_cache = (None, 0)
+        self._journal = _FlushJournal(
+            os.path.join(checkpoint_dir, "journal.bin"),
+            self._config.num_reservoirs,
+            self._config.tile_size,
+            np.dtype(self._config.element_dtype),
+            self._config.weighted,
+            fsync=durability == "fsync",
+            sync_cb=self._count_journal_sync,
+        )
+
     def _save_snapshot(self) -> None:
         """Checkpoint engine state covering every flush ``<= _flush_seq``
         (atomic: temp file + rename inside ``utils.checkpoint``), then drop
@@ -839,6 +998,7 @@ class DeviceStreamBridge:
         filters out by sequence number."""
         from ..utils.checkpoint import save_engine
 
+        self._check_fence()
         save_engine(
             os.path.join(self._ckpt_dir, "engine.npz"),
             self._engine,
@@ -848,6 +1008,7 @@ class DeviceStreamBridge:
                     "reusable": self._reusable,
                     "pipelined": self._pipeline is not None,
                     "checkpoint_every": self._ckpt_every,
+                    "durability": self._durability,
                     "elements": self._metrics.elements,
                     "flushed_elements": self._metrics.flushed_elements,
                 }
@@ -864,6 +1025,8 @@ class DeviceStreamBridge:
         self.drain_barrier()
         try:
             self._save_snapshot()
+        except FencedError:
+            raise  # not a durability degradation: this primary must STOP
         except Exception as e:
             # degraded durability, not lost availability: the previous
             # checkpoint is intact (atomic write) and the journal keeps
@@ -893,6 +1056,7 @@ class DeviceStreamBridge:
         checkpoint_every: Optional[int] = None,
         faults: Optional[Any] = None,
         *,
+        durability: Optional[str] = None,
         replay_hook: Optional[Any] = None,
     ) -> "DeviceStreamBridge":
         """Reconstruct a crashed auto-checkpointing bridge from its
@@ -941,6 +1105,11 @@ class DeviceStreamBridge:
                 int(info["checkpoint_every"])
                 if checkpoint_every is None
                 else checkpoint_every
+            ),
+            durability=(
+                info.get("durability", "buffered")
+                if durability is None
+                else durability
             ),
             faults=faults,
             _engine=engine,

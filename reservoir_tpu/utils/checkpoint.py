@@ -44,12 +44,22 @@ from typing import Any, Callable, Optional, Tuple
 
 import numpy as np
 
-from ..errors import CheckpointCorrupt
+from ..errors import CheckpointCorrupt, CheckpointMismatch
 from . import faults
 
-__all__ = ["save_state", "load_state", "save_engine", "load_engine"]
+__all__ = [
+    "save_state",
+    "load_state",
+    "save_engine",
+    "load_engine",
+    "read_engine_metadata",
+    "read_epoch",
+    "write_epoch",
+    "advance_epoch",
+]
 
 _FORMAT_VERSION = 1
+_EPOCH_NAME = "epoch.json"
 
 
 def _state_registry():
@@ -193,6 +203,57 @@ def _read_npz(path: str) -> Tuple[dict, dict]:
     return arrays, manifest
 
 
+# ------------------------------------------------------------- epoch fencing
+
+
+def read_epoch(directory: str) -> int:
+    """The primary epoch persisted in a checkpoint directory (0 when none
+    was ever written).  A writer admitted at epoch E must refuse durable
+    writes once the persisted epoch exceeds E (the HA plane's split-brain
+    fence, :class:`~reservoir_tpu.errors.FencedError`)."""
+    try:
+        with open(os.path.join(directory, _EPOCH_NAME), encoding="utf-8") as fh:
+            return int(json.load(fh)["epoch"])
+    except FileNotFoundError:
+        return 0
+    except (OSError, ValueError, KeyError, TypeError) as e:
+        raise CheckpointCorrupt(
+            f"epoch file in {directory!r} is unreadable "
+            f"({type(e).__name__}: {e})"
+        ) from e
+
+
+def write_epoch(directory: str, epoch: int) -> int:
+    """Persist ``epoch`` atomically (temp file + rename, fsynced file AND
+    directory: the fence must survive an OS crash — an un-durable epoch
+    bump could un-fence the old primary on reboot)."""
+    os.makedirs(directory, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=directory, suffix=".tmp.epoch")
+    try:
+        with os.fdopen(fd, "w", encoding="utf-8") as fh:
+            json.dump({"epoch": int(epoch)}, fh)
+            fh.flush()
+            os.fsync(fh.fileno())
+        os.replace(tmp, os.path.join(directory, _EPOCH_NAME))
+        dir_fd = os.open(directory, os.O_RDONLY)
+        try:
+            os.fsync(dir_fd)
+        finally:
+            os.close(dir_fd)
+    except BaseException:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+        raise
+    return int(epoch)
+
+
+def advance_epoch(directory: str) -> int:
+    """Bump and persist the primary epoch; returns the new value.  This is
+    the fencing half of a failover promotion: every writer admitted at an
+    older epoch fails its next durable write with ``FencedError``."""
+    return write_epoch(directory, read_epoch(directory) + 1)
+
+
 def save_state(path: str, state: Any, metadata: Optional[dict] = None) -> None:
     """Write one state pytree (``ReservoirState`` / ``DistinctState`` /
     ``WeightedState``) to ``path`` atomically.  ``metadata`` (JSON-able) rides
@@ -239,14 +300,98 @@ def save_engine(path: str, engine, metadata: Optional[dict] = None) -> None:
     arrays, manifest = _pack_state(engine._state)
     manifest["format_version"] = _FORMAT_VERSION
     manifest["metadata"] = metadata or {}
+    import jax
+
     manifest["engine"] = {
         "config": _config_to_jsonable(engine.config),
         "reusable": engine._reusable,
         "min_count": engine._min_count,
         "has_map_fn": engine._map_fn is not None,
         "has_hash_fn": engine._hash_fn is not None,
+        # the backend this checkpoint was taken on: the recovery pre-flight
+        # names it when a restore lands on an incompatible mesh
+        "backend": {
+            "platform": jax.default_backend(),
+            "device_count": jax.device_count(),
+        },
     }
     _atomic_write_npz(path, arrays, manifest)
+
+
+def read_engine_metadata(path: str) -> dict:
+    """The ``metadata`` dict a checkpoint was saved with, WITHOUT restoring
+    the engine (no jax state construction — the journal follower polls this
+    to learn a newer checkpoint's flush watermark cheaply)."""
+    try:
+        with np.load(path) as data:
+            if "__manifest__" not in data.files:
+                raise CheckpointCorrupt(
+                    f"{path!r} has no checkpoint manifest"
+                )
+            manifest = json.loads(bytes(data["__manifest__"]).decode())
+    except FileNotFoundError:
+        raise
+    except (zipfile.BadZipFile, EOFError, OSError, KeyError, ValueError) as e:
+        if isinstance(e, CheckpointCorrupt):
+            raise
+        raise CheckpointCorrupt(
+            f"checkpoint {path!r} is truncated or corrupt "
+            f"({type(e).__name__}: {e})"
+        ) from e
+    return manifest.get("metadata", {})
+
+
+#: State fields whose second dimension is the sample capacity ``k`` — the
+#: pre-flight checks these against ``config.max_sample_size``.
+_K_FIELDS = frozenset({"samples", "values", "lkeys", "hash_hi", "hash_lo"})
+
+
+def _preflight(path: str, config, arrays: dict, manifest: dict) -> None:
+    """Typed recovery pre-flight: refuse a restore whose state arrays or
+    backend requirements cannot match, naming the mismatch, instead of an
+    opaque shape/compile error deep inside XLA."""
+    R = config.num_reservoirs
+    for field in manifest.get("fields", ()):
+        if field.get("kind") == "none":
+            continue
+        name = field["name"]
+        arr = arrays.get(name)
+        if arr is None:
+            raise CheckpointCorrupt(
+                f"checkpoint {path!r}: state field {name!r} listed in the "
+                "manifest is missing from the archive"
+            )
+        if arr.ndim < 1 or arr.shape[0] != R:
+            raise CheckpointMismatch(
+                f"checkpoint {path!r}: state field {name!r} has leading "
+                f"dimension {arr.shape[0] if arr.ndim else '<scalar>'}, but "
+                f"the recorded config has num_reservoirs={R}"
+            )
+        if name in _K_FIELDS and arr.ndim >= 2 and (
+            arr.shape[1] != config.max_sample_size
+        ):
+            raise CheckpointMismatch(
+                f"checkpoint {path!r}: state field {name!r} has sample "
+                f"capacity {arr.shape[1]}, but the recorded config has "
+                f"max_sample_size={config.max_sample_size}"
+            )
+    if config.mesh_axis is not None:
+        import jax
+
+        live = jax.device_count()
+        if R % live:
+            saved = (manifest.get("engine") or {}).get("backend") or {}
+            was = (
+                f"; it was taken on {saved['device_count']} "
+                f"{saved.get('platform', '?')} device(s)"
+                if saved.get("device_count")
+                else ""
+            )
+            raise CheckpointMismatch(
+                f"checkpoint {path!r} shards {R} reservoirs over mesh axis "
+                f"{config.mesh_axis!r}, which does not divide evenly over "
+                f"the {live} device(s) of the live backend{was}"
+            )
 
 
 def load_engine(
@@ -282,6 +427,7 @@ def load_engine(
                 f"{'present' if info[flag] else 'absent'}; restore must match"
             )
     config = SamplerConfig(**info["config"])
+    _preflight(path, config, arrays, manifest)
     engine = (engine_cls or ReservoirEngine)(
         config,
         map_fn=map_fn,

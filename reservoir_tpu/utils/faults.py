@@ -65,6 +65,12 @@ __all__ = [
 #: ``native.staging`` on the staging buffer's push/drain paths, and
 #: ``serve.ingest`` on the serving plane's per-session ingest (surfaced to
 #: the caller as a typed per-session error — the service stays live).
+#: The HA plane (ISSUE 5) adds ``replica.ship`` (the journal follower's
+#: read/tail path), ``replica.apply`` (applying one shipped tile to the
+#: standby engine — state advances only on success, so an injected failure
+#: is retried bit-exactly on the next poll), and ``ha.heartbeat`` (the
+#: primary's heartbeat write and the controller's read — a failing writer
+#: goes stale and triggers promotion).
 SITES: Tuple[str, ...] = (
     "bridge.dispatch",
     "bridge.demux",
@@ -73,6 +79,9 @@ SITES: Tuple[str, ...] = (
     "engine.pallas",
     "native.staging",
     "serve.ingest",
+    "replica.ship",
+    "replica.apply",
+    "ha.heartbeat",
 )
 
 

@@ -50,6 +50,19 @@ def test_bench_prints_one_parseable_json_line(config):
     assert config in rec["metric"] or config == "algl"
 
 
+def test_bench_ha_row_reports_failover_and_lag():
+    # the ISSUE-5 acceptance: `bench.py ha` must report failover time and
+    # steady-state replication lag on top of the standard row contract
+    rec = _run_bench({"RESERVOIR_BENCH_CONFIG": "ha"})
+    assert "ha_replicated_feed" in rec["metric"]
+    assert rec["failover_ms"] > 0
+    assert rec["lag_seq"] >= 0 and rec["lag_s"] >= 0.0
+    stages = rec["stages"]
+    assert stages["failover_ms_best"] <= stages["failover_ms_median"]
+    assert stages["ha"]["promotions"] == 1  # one failover per timed pass
+    assert stages["ha"]["fenced_writes"] == 0  # clean handoff: no zombie
+
+
 def test_bench_rejects_unknown_config():
     env = dict(os.environ)
     env.update(RESERVOIR_BENCH_SMOKE="1", RESERVOIR_BENCH_CONFIG="nope")

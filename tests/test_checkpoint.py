@@ -236,3 +236,92 @@ def test_restore_refuses_dtype_narrowing(tmp_path):
     with _enable_x64(True):
         st = load_state(path)  # x64 on: restores fine
         assert st.count.dtype == jnp.int64
+
+
+# ------------------------------------------- recovery pre-flight (ISSUE 5)
+
+
+def _tampered_engine_checkpoint(tmp_path, mutate):
+    """Save a real engine checkpoint, then rewrite its embedded manifest
+    (and/or arrays) through ``mutate(manifest, arrays)``."""
+    import json
+
+    config = SamplerConfig(max_sample_size=4, num_reservoirs=4, tile_size=8)
+    eng = ReservoirEngine(config, key=0, reusable=True)
+    eng.sample(_tile(4, 8, 0))
+    path = str(tmp_path / "pf.npz")
+    save_engine(path, eng)
+    with np.load(path) as data:
+        manifest = json.loads(bytes(data["__manifest__"]).decode())
+        arrays = {k: data[k] for k in data.files if k != "__manifest__"}
+    mutate(manifest, arrays)
+    with open(path, "wb") as f:
+        np.savez(
+            f,
+            __manifest__=np.frombuffer(
+                json.dumps(manifest).encode(), dtype=np.uint8
+            ),
+            **arrays,
+        )
+    return path
+
+
+def test_preflight_names_reservoir_count_mismatch(tmp_path):
+    # a checkpoint whose state arrays disagree with its recorded config
+    # must fail the typed pre-flight naming the field, not an XLA shape
+    # error deep in engine construction
+    from reservoir_tpu.errors import CheckpointCorrupt, CheckpointMismatch
+
+    def grow_R(manifest, arrays):
+        manifest["engine"]["config"]["num_reservoirs"] = 12
+
+    path = _tampered_engine_checkpoint(tmp_path, grow_R)
+    with pytest.raises(CheckpointMismatch, match="num_reservoirs=12"):
+        load_engine(path)
+    assert issubclass(CheckpointMismatch, CheckpointCorrupt)
+
+
+def test_preflight_names_sample_capacity_mismatch(tmp_path):
+    from reservoir_tpu.errors import CheckpointMismatch
+
+    def shrink_k(manifest, arrays):
+        manifest["engine"]["config"]["max_sample_size"] = 2
+
+    path = _tampered_engine_checkpoint(tmp_path, shrink_k)
+    with pytest.raises(CheckpointMismatch, match="max_sample_size=2"):
+        load_engine(path)
+
+
+def test_preflight_names_missing_state_field(tmp_path):
+    from reservoir_tpu.errors import CheckpointCorrupt
+
+    def drop_field(manifest, arrays):
+        arrays.pop("samples")
+
+    path = _tampered_engine_checkpoint(tmp_path, drop_field)
+    with pytest.raises(CheckpointCorrupt, match="samples"):
+        load_engine(path)
+
+
+def test_preflight_rejects_mesh_onto_wrong_device_count(tmp_path, monkeypatch):
+    # the headline satellite case: a meshed checkpoint recovering onto a
+    # backend whose device count cannot shard it must raise the typed
+    # mismatch naming BOTH sides (saved backend vs live), before any
+    # engine/XLA construction runs
+    from reservoir_tpu.errors import CheckpointMismatch
+
+    config = SamplerConfig(
+        max_sample_size=4, num_reservoirs=8, tile_size=8, mesh_axis="res"
+    )
+    eng = ReservoirEngine(config, key=0, reusable=True)  # 8 rows / 8 devices
+    eng.sample(_tile(8, 8, 0))
+    path = str(tmp_path / "mesh.npz")
+    save_engine(path, eng)
+    restored = load_engine(path)  # same backend: pre-flight passes
+    assert restored.config.mesh_axis == "res"
+    monkeypatch.setattr(jax, "device_count", lambda *a, **k: 5)
+    with pytest.raises(CheckpointMismatch) as exc_info:
+        load_engine(path)
+    msg = str(exc_info.value)
+    assert "5 device(s)" in msg and "'res'" in msg
+    assert "8 " in msg  # the saved backend's device count is named too

@@ -151,7 +151,8 @@ def test_disabled_plane_is_zero_overhead_noop(monkeypatch):
 def test_all_sites_exercised(tmp_path):
     # a rule-free global plane counts hits without raising: one bridge
     # stream with auto-checkpointing must cross every site of ISSUE 3,
-    # plus one serve-plane ingest for the ISSUE-4 site
+    # one serve-plane ingest the ISSUE-4 site, and one replication poll +
+    # heartbeat the ISSUE-5 sites
     with faults.active(FaultPlane()) as plane:
         bridge = DeviceStreamBridge(
             _cfg(),
@@ -168,11 +169,22 @@ def test_all_sites_exercised(tmp_path):
         eng = ReservoirEngine(_cfg(impl="pallas"), key=0, reusable=True)
         eng.sample(np.arange(16, dtype=np.int32).reshape(2, 8))
         # serve.ingest fires on the serving plane's per-session ingest
-        from reservoir_tpu.serve import ReservoirService
+        from reservoir_tpu.serve import (
+            HeartbeatWriter,
+            ReservoirService,
+            StandbyReplica,
+        )
 
-        svc = ReservoirService(_cfg(), key=0)
+        ha_dir = str(tmp_path / "ha")
+        svc = ReservoirService(_cfg(), key=0, checkpoint_dir=ha_dir)
         svc.open_session("s")
         svc.ingest("s", np.arange(4, dtype=np.int32))
+        svc.sync()
+        # replica.ship + replica.apply fire on the standby's poll,
+        # ha.heartbeat on the primary's beacon
+        standby = StandbyReplica(ha_dir)
+        standby.poll()
+        HeartbeatWriter(ha_dir, service=svc).beat()
         hits = plane.hits()
     for site in faults.SITES:
         assert hits.get(site, 0) >= 1, (site, hits)
@@ -548,6 +560,113 @@ def test_serve_ingest_fault_via_env_spec(monkeypatch):
     svc.ingest("a", np.arange(4, dtype=np.int32))  # times=1: exhausted
     monkeypatch.delenv("RESERVOIR_FAULTS")
     faults.install_from_env()
+
+
+# ------------------------------------------------- HA sites (ISSUE 5)
+
+
+def _ha_primary(tmp_path, key=8):
+    from reservoir_tpu.serve import ReservoirService
+
+    ck = str(tmp_path / "ha")
+    svc = ReservoirService(
+        _cfg(), key=key, checkpoint_dir=ck, checkpoint_every=1000,
+        coalesce_bytes=32,
+    )
+    svc.open_session("a")
+    svc.ingest("a", np.arange(40, dtype=np.int32))
+    svc.sync()
+    return svc, ck
+
+
+def test_replica_ship_fault_retries_and_lag_grows_never_corrupts(tmp_path):
+    """The ISSUE-5 matrix entry for ``replica.ship``: an injected journal-
+    read failure makes the poll return empty (counted, lag grows), the
+    cursor never advances past unread records, and once the fault clears
+    the standby converges bit-identically — never a corrupt replica."""
+    from reservoir_tpu.serve import StandbyReplica
+
+    svc, ck = _ha_primary(tmp_path)
+    plane = FaultPlane(
+        [FaultRule("replica.ship", exc=TransientDeviceError, after=1,
+                   times=2)]
+    )
+    standby = StandbyReplica(ck, faults=plane)
+    assert standby.poll() > 0  # hit 0: clean, catches up
+    assert standby.lag()[0] == 0
+    svc.ingest("a", np.arange(500, 540, dtype=np.int32))
+    svc.sync()
+    assert standby.poll() == 0  # hit 1: injected ship failure
+    assert standby.metrics.ship_errors == 1
+    assert isinstance(standby.last_error, TransientDeviceError)
+    lag_seq, lag_s = standby.lag()
+    assert standby.applied_seq < svc.flushed_seq  # behind, not corrupt
+    assert standby.poll() == 0  # hit 2: still failing; lag keeps growing
+    assert standby.metrics.ship_errors == 2
+    assert standby.poll() > 0  # times=2 exhausted: converges
+    assert standby.lag() == (0, 0.0)
+    np.testing.assert_array_equal(standby.snapshot("a"), svc.snapshot("a"))
+
+
+def test_replica_apply_fault_retries_tile_bit_exactly(tmp_path):
+    """``replica.apply``: the site fires BEFORE the engine update, so an
+    injected apply failure leaves standby state untouched; the next poll
+    re-applies the same journaled bytes — bit-identical convergence."""
+    from reservoir_tpu.serve import StandbyReplica
+
+    svc, ck = _ha_primary(tmp_path, key=9)
+    plane = FaultPlane(
+        [FaultRule("replica.apply", exc=RuntimeError, after=2, times=1)]
+    )
+    standby = StandbyReplica(ck, faults=plane)
+    polls = 0
+    while standby.lag()[0] or standby.applied_seq < svc.flushed_seq:
+        standby.poll()
+        polls += 1
+        assert polls < 10, "standby failed to converge past the apply fault"
+    assert standby.metrics.apply_errors == 1
+    samples_p, sizes_p = svc.bridge.engine.peek_arrays()
+    samples_s, sizes_s = standby.service.bridge.engine.peek_arrays()
+    np.testing.assert_array_equal(samples_s, samples_p)
+    np.testing.assert_array_equal(sizes_s, sizes_p)
+
+
+def test_heartbeat_fault_starves_beacon_and_controller_promotes(tmp_path):
+    """``ha.heartbeat``: an injected writer fault stops the beacon; the
+    file goes stale and the controller's next check promotes the standby
+    — the end-to-end failure-detection story of the HA plane."""
+    from reservoir_tpu.errors import FencedError
+    from reservoir_tpu.serve import (
+        FailoverController,
+        HeartbeatWriter,
+        StandbyReplica,
+    )
+
+    svc, ck = _ha_primary(tmp_path, key=10)
+    clock = {"t": 1000.0}
+    plane = FaultPlane(
+        [FaultRule("ha.heartbeat", exc=OSError, after=1)]
+    )
+    hb = HeartbeatWriter(
+        ck, service=svc, clock=lambda: clock["t"], faults=plane
+    )
+    hb.beat()  # hit 0: the last heartbeat the primary ever lands
+    standby = StandbyReplica(ck)
+    standby.poll()
+    ctl = FailoverController(
+        standby, heartbeat_timeout_s=5.0, clock=lambda: clock["t"]
+    )
+    assert not ctl.health().should_promote
+    clock["t"] += 10.0
+    with pytest.raises(OSError):
+        hb.beat()  # the injected fault: beats stop reaching the file
+    report = ctl.health()
+    assert report.should_promote
+    promoted = ctl.maybe_promote()
+    assert promoted is not None
+    assert standby.metrics.promotions == 1
+    with pytest.raises(FencedError):
+        svc.sync()  # and the fenced old primary is out
 
 
 # -------------------------------------------------------- Pallas demotion

@@ -1,0 +1,649 @@
+"""HA plane: journal follower, hot standby, epoch-fenced failover, chaos soak.
+
+ISSUE 5: the serving plane's ``recover()`` (PR 3/4) is stop-the-world —
+a crash means downtime for a full checkpoint load + journal replay, and
+nothing stopped a half-dead "recovered twice" primary from double-serving
+rows.  This suite pins the replacement:
+
+- :class:`JournalFollower` — resumable CRC-checked byte-cursor tail of
+  ``journal.bin`` (torn-tail tolerant, rotation- and gap-aware);
+- :class:`StandbyReplica` — checkpoint-shipping bootstrap + incremental
+  apply, bit-identical to the primary at every applied watermark;
+- epoch fencing — ``promote()`` persists a bumped epoch; the fenced old
+  primary's next flush/checkpoint/heartbeat raises ``FencedError``
+  WITHOUT mutating the journal;
+- :class:`FailoverController` — heartbeat-staleness / watchdog health
+  model driving promotion;
+- the chaos soak: >= 20 randomized kill→promote→re-follow cycles across
+  all three sampling modes with faults injected at every new site,
+  asserting per-session snapshots stay bit-identical to the per-session
+  oracle after every promotion.
+
+Plus the ISSUE-5 satellites: the journal durability knob (buffered
+default = zero fsyncs) and typed recovery pre-flight coverage lives in
+``tests/test_checkpoint.py``; the fault-site matrices in
+``tests/test_faults.py``.
+"""
+
+from __future__ import annotations
+
+import gc
+import os
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from test_serve import _oracle_replay  # noqa: E402  (the per-session oracle)
+
+from reservoir_tpu import SamplerConfig
+from reservoir_tpu.errors import FencedError, TransientDeviceError
+from reservoir_tpu.serve import (
+    FailoverController,
+    HeartbeatWriter,
+    JournalFollower,
+    ReservoirService,
+    StandbyReplica,
+    read_heartbeat,
+)
+from reservoir_tpu.stream.bridge import DeviceStreamBridge, _FlushJournal
+from reservoir_tpu.utils import faults
+from reservoir_tpu.utils.faults import FaultPlane, FaultRule
+
+
+@pytest.fixture(autouse=True)
+def _no_global_plane():
+    faults.uninstall()
+    yield
+    faults.uninstall()
+
+
+def _cfg(mode="plain", **kw):
+    kw.setdefault("max_sample_size", 3)
+    kw.setdefault("num_reservoirs", 4)
+    kw.setdefault("tile_size", 8)
+    return SamplerConfig(
+        distinct=(mode == "distinct"), weighted=(mode == "weighted"), **kw
+    )
+
+
+def _journal_bytes(ckdir: str) -> bytes:
+    path = os.path.join(ckdir, "journal.bin")
+    return open(path, "rb").read() if os.path.exists(path) else b""
+
+
+# --------------------------------------------------------- journal follower
+
+
+def test_follower_tails_resumes_and_tolerates_torn_tail(tmp_path):
+    path = str(tmp_path / "journal.bin")
+    S, B = 2, 4
+    journal = _FlushJournal(path, S, B, np.int32, weighted=False)
+
+    def rec(seq):
+        return (
+            np.full((S, B), seq, np.int32),
+            np.full(S, B, np.int32),
+            None,
+        )
+
+    for seq in (1, 2):
+        journal.append(seq, *rec(seq))
+    follower = JournalFollower(path, S, B, np.int32, False)
+    records, rotated, gap = follower.poll()
+    assert [r[1] for r in records] == [1, 2] and not rotated and not gap
+    for end, seq, tile, valid, _ in records:
+        np.testing.assert_array_equal(tile, rec(seq)[0])
+        follower.advance(seq, end)
+    # caught up: a poll finds nothing, the cursor holds
+    assert follower.poll() == ([], False, False)
+    # incremental append resumes from the byte cursor
+    journal.append(3, *rec(3))
+    records, _, _ = follower.poll()
+    assert [r[1] for r in records] == [3]
+    follower.advance(records[-1][1], records[-1][0])
+    # torn tail (primary mid-append): retried, cursor does not advance
+    full = os.path.getsize(path)
+    journal.append(4, *rec(4))
+    with open(path, "r+b") as fh:
+        fh.truncate(full + 9)
+    assert follower.poll() == ([], False, False)
+    journal.close()
+    # the frame completes: the record arrives on the next poll
+    journal2 = _FlushJournal(path, S, B, np.int32, weighted=False)
+    with open(path, "r+b") as fh:
+        fh.truncate(full)
+    journal2.append(4, *rec(4))
+    records, _, _ = follower.poll()
+    assert [r[1] for r in records] == [4]
+    follower.advance(records[-1][1], records[-1][0])
+
+    # rotation: truncate-to-zero then append the NEXT seq -> detected,
+    # rescanned from byte 0, no gap
+    journal2.rotate()
+    journal2.append(5, *rec(5))
+    records, rotated, gap = follower.poll()
+    assert [r[1] for r in records] == [5] and rotated and not gap
+    follower.advance(records[-1][1], records[-1][0])
+    # rotation that dropped records we never saw -> gap (re-bootstrap cue)
+    journal2.rotate()
+    journal2.append(7, *rec(7))  # seq 6 lost to the rotation
+    records, rotated, gap = follower.poll()
+    assert records == [] and gap
+    journal2.close()
+
+
+def test_follower_detects_same_size_rotation(tmp_path):
+    # frames are fixed-size, so a rotated journal regrown to the same
+    # byte length defeats any size check — the content probe must catch it
+    path = str(tmp_path / "journal.bin")
+    S, B = 2, 4
+    journal = _FlushJournal(path, S, B, np.int32, weighted=False)
+    tile, valid = np.ones((S, B), np.int32), np.full(S, B, np.int32)
+    journal.append(1, tile, valid, None)
+    follower = JournalFollower(path, S, B, np.int32, False)
+    records, _, _ = follower.poll()
+    follower.advance(records[-1][1], records[-1][0])
+    journal.rotate()
+    journal.append(3, tile, valid, None)  # same size, seq 2 lost
+    records, rotated, gap = follower.poll()
+    assert records == [] and rotated and gap
+    journal.close()
+
+
+# ------------------------------------------------------------- the standby
+
+
+@pytest.mark.parametrize("mode", ["plain", "weighted", "distinct"])
+def test_standby_tracks_primary_bit_exactly(tmp_path, mode):
+    cfg = _cfg(mode, num_reservoirs=3)  # full table: close+open recycles
+    ck = str(tmp_path / "ck")
+    svc = ReservoirService(
+        cfg, key=9, checkpoint_dir=ck, checkpoint_every=1000,
+        coalesce_bytes=64,
+    )
+    rng = np.random.default_rng(1)
+    for i in range(3):
+        key = f"s{i}"
+        svc.open_session(key)
+        elems = ((i + 1) * 1000 + rng.integers(0, 500, 30)).astype(np.int32)
+        w = (
+            rng.uniform(0.1, 2.0, 30).astype(np.float32)
+            if mode == "weighted"
+            else None
+        )
+        svc.ingest(key, elems, weights=w)
+    svc.sync()
+    standby = StandbyReplica(ck)
+    assert standby.poll() > 0
+    assert standby.lag() == (0, 0.0)
+    for i in range(3):
+        np.testing.assert_array_equal(
+            standby.snapshot(f"s{i}"), svc.snapshot(f"s{i}")
+        )
+    # recycling replicates too: the reset lands between the same flushes
+    svc.close_session("s0")
+    svc.open_session("s3")  # recycles s0's row at generation 1
+    elems = (9000 + rng.integers(0, 500, 40)).astype(np.int32)
+    w = (
+        rng.uniform(0.1, 2.0, 40).astype(np.float32)
+        if mode == "weighted"
+        else None
+    )
+    svc.ingest("s3", elems, weights=w)
+    svc.sync()
+    standby.poll()
+    assert standby.table.route("s3").generation == 1
+    np.testing.assert_array_equal(
+        standby.snapshot("s3"), svc.snapshot("s3")
+    )
+    samples_p, sizes_p = svc.bridge.engine.peek_arrays()
+    samples_s, sizes_s = standby.service.bridge.engine.peek_arrays()
+    np.testing.assert_array_equal(samples_s, samples_p)
+    np.testing.assert_array_equal(sizes_s, sizes_p)
+
+
+def test_standby_rebootstraps_when_rotation_outruns_the_tail(tmp_path):
+    cfg = _cfg(num_reservoirs=3)
+    ck = str(tmp_path / "ck")
+    svc = ReservoirService(
+        cfg, key=2, checkpoint_dir=ck, checkpoint_every=2, coalesce_bytes=32
+    )
+    svc.open_session("a")
+    svc.ingest("a", np.arange(50, dtype=np.int32))
+    svc.sync()
+    standby = StandbyReplica(ck)
+    standby.poll()
+    # several checkpoint rotations while the standby sleeps
+    for i in range(4):
+        svc.ingest("a", np.arange(i * 100, i * 100 + 40, dtype=np.int32))
+        svc.sync()
+    want = svc.snapshot("a")
+    standby.poll()
+    assert standby.metrics.bootstraps >= 2  # checkpoint-shipping re-ship
+    assert standby.applied_seq == svc.flushed_seq
+    np.testing.assert_array_equal(standby.snapshot("a"), want)
+
+
+# ------------------------------------------------- promotion + epoch fence
+
+
+def test_promote_fences_old_primary_without_mutating_journal(tmp_path):
+    cfg = _cfg(num_reservoirs=3)
+    ck = str(tmp_path / "ck")
+    old = ReservoirService(
+        cfg, key=5, checkpoint_dir=ck, checkpoint_every=1000,
+        coalesce_bytes=64,
+    )
+    hb = HeartbeatWriter(ck, service=old)
+    old.open_session("a")
+    old.ingest("a", np.arange(40, dtype=np.int32))
+    old.sync()
+    hb.beat()
+    before = old.snapshot("a")
+    standby = StandbyReplica(ck)
+    standby.poll()
+    promoted = standby.promote()
+    assert standby.is_promoted
+    assert standby.metrics.promotions == 1
+    np.testing.assert_array_equal(promoted.snapshot("a"), before)
+    # the fenced old primary fails its next durable write...
+    journal_before = _journal_bytes(ck)
+    with pytest.raises(FencedError):
+        old.sync()
+    # ...and an ingest big enough to force a flush fails the same way...
+    with pytest.raises(FencedError):
+        old.ingest("a", np.arange(100, dtype=np.int32))
+        old.sync()
+    # ...with the journal untouched byte-for-byte
+    assert _journal_bytes(ck) == journal_before
+    assert old.bridge.metrics.fenced_writes >= 1
+    # the fenced heartbeat refuses to claim liveness
+    with pytest.raises(FencedError):
+        hb.beat()
+    assert hb.metrics.fenced_writes == 1
+    # the promoted primary journals on: ingest, checkpoint, re-follow
+    promoted.ingest("a", np.arange(500, 540, dtype=np.int32))
+    promoted.sync()
+    want = promoted.snapshot("a")
+    refollow = StandbyReplica(ck)
+    refollow.poll()
+    np.testing.assert_array_equal(refollow.snapshot("a"), want)
+    # a second promotion fences the first promoted primary in turn
+    promoted2 = refollow.promote()
+    with pytest.raises(FencedError):
+        promoted.sync()
+    assert promoted2.snapshot("a").size > 0
+
+
+def test_promote_is_refused_while_tail_unreadable(tmp_path):
+    # a standby that cannot drain the tail must NOT go live half-caught-up
+    cfg = _cfg(num_reservoirs=2)
+    ck = str(tmp_path / "ck")
+    svc = ReservoirService(
+        cfg, key=7, checkpoint_dir=ck, checkpoint_every=1000,
+        coalesce_bytes=32,
+    )
+    svc.open_session("a")
+    svc.ingest("a", np.arange(40, dtype=np.int32))
+    svc.sync()
+    standby = StandbyReplica(
+        ck,
+        faults=FaultPlane(
+            [FaultRule("replica.ship", exc=TransientDeviceError)]
+        ),
+    )
+    with pytest.raises(RuntimeError, match="tail not drained"):
+        standby.promote(drain_attempts=3)
+    assert not standby.is_promoted
+    assert standby.metrics.promotions == 0
+
+
+# -------------------------------------------------------------- controller
+
+
+class _Clock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def test_controller_promotes_on_stale_heartbeat(tmp_path):
+    cfg = _cfg(num_reservoirs=2)
+    ck = str(tmp_path / "ck")
+    svc = ReservoirService(cfg, key=3, checkpoint_dir=ck)
+    svc.open_session("a")
+    svc.ingest("a", np.arange(20, dtype=np.int32))
+    svc.sync()
+    clock = _Clock()
+    hb = HeartbeatWriter(ck, service=svc, clock=clock)
+    hb.beat()
+    assert read_heartbeat(ck)["seq"] == svc.flushed_seq
+    standby = StandbyReplica(ck)
+    standby.poll()
+    ctl = FailoverController(standby, heartbeat_timeout_s=5.0, clock=clock)
+    report = ctl.health()
+    assert report.healthy and not report.should_promote
+    assert ctl.maybe_promote() is None
+    clock.t += 3.0
+    hb.beat()  # a fresh beat keeps the primary alive
+    assert not ctl.health().should_promote
+    clock.t += 10.0  # the primary dies: beats stop, the file goes stale
+    report = ctl.health()
+    assert report.should_promote and "stale" in report.reasons[0]
+    promoted = ctl.maybe_promote()
+    assert promoted is not None
+    assert standby.metrics.promotions == 1
+    assert "stale" in ctl.last_promotion_reason
+    with pytest.raises(FencedError):
+        svc.sync()
+
+
+def test_controller_promotes_on_watchdog_trips_and_flags_degraded(tmp_path):
+    cfg = _cfg(num_reservoirs=2)
+    ck = str(tmp_path / "ck")
+    svc = ReservoirService(cfg, key=4, checkpoint_dir=ck)
+    svc.open_session("a")
+    svc.ingest("a", np.arange(20, dtype=np.int32))
+    svc.sync()
+    clock = _Clock()
+    hb = HeartbeatWriter(ck, service=svc, clock=clock)
+    # demotions alone: degraded, NOT promote-worthy by default
+    svc.bridge.metrics.demotions = 1
+    hb.beat()
+    standby = StandbyReplica(ck)
+    standby.poll()
+    ctl = FailoverController(standby, heartbeat_timeout_s=60.0, clock=clock)
+    report = ctl.health()
+    assert not report.should_promote and not report.healthy
+    assert any("demotions" in r for r in report.reasons)
+    # a tripped flush watchdog means the pipeline is wedged: promote
+    svc.bridge.metrics.watchdog_trips = 1
+    hb.beat()
+    report = ctl.health()
+    assert report.should_promote
+    assert any("watchdog" in r for r in report.reasons)
+
+
+def test_controller_promotes_when_heartbeat_never_existed(tmp_path):
+    # a primary that died before its first beat is equally dead: missing
+    # heartbeats age from the controller's first check
+    cfg = _cfg(num_reservoirs=2)
+    ck = str(tmp_path / "ck")
+    svc = ReservoirService(cfg, key=6, checkpoint_dir=ck)
+    svc.open_session("a")
+    svc.ingest("a", np.arange(20, dtype=np.int32))
+    svc.sync()
+    standby = StandbyReplica(ck)
+    standby.poll()
+    clock = _Clock()
+    ctl = FailoverController(standby, heartbeat_timeout_s=5.0, clock=clock)
+    assert not ctl.health().should_promote  # grace: just started watching
+    clock.t += 10.0
+    report = ctl.health()
+    assert report.should_promote and "no heartbeat" in report.reasons[0]
+
+
+# ------------------------------------------------- durability knob satellite
+
+
+def _count_fsyncs(monkeypatch):
+    calls = {"n": 0}
+    real = os.fsync
+
+    def counting(fd):
+        calls["n"] += 1
+        return real(fd)
+
+    monkeypatch.setattr(os, "fsync", counting)
+    return calls
+
+
+def test_durability_buffered_is_default_and_zero_fsync(tmp_path, monkeypatch):
+    bridge = DeviceStreamBridge(
+        _cfg(num_reservoirs=2, max_sample_size=4),
+        key=1,
+        checkpoint_dir=str(tmp_path / "ck"),
+        checkpoint_every=10_000,  # no periodic checkpoint in this window
+    )
+    calls = _count_fsyncs(monkeypatch)
+    bridge.push(0, np.arange(64, dtype=np.int32))  # 8 journaled flushes
+    bridge.drain_barrier()
+    assert bridge.metrics.flushes == 8
+    assert calls["n"] == 0, "buffered journal appends must never fsync"
+    assert bridge.metrics.journal_syncs == 0
+    bridge.complete()
+
+
+def test_durability_fsync_syncs_every_frame_and_rotation(
+    tmp_path, monkeypatch
+):
+    bridge = DeviceStreamBridge(
+        _cfg(num_reservoirs=2, max_sample_size=4),
+        key=1,
+        checkpoint_dir=str(tmp_path / "ck"),
+        checkpoint_every=10_000,
+        durability="fsync",
+    )
+    base = bridge.metrics.journal_syncs  # the seq-0 anchor's rotation
+    calls = _count_fsyncs(monkeypatch)
+    bridge.push(0, np.arange(64, dtype=np.int32))
+    bridge.drain_barrier()
+    assert bridge.metrics.flushes == 8
+    assert bridge.metrics.journal_syncs == base + 8  # one per frame
+    assert calls["n"] >= 8
+    # rotation (checkpoint) adds the file + directory syncs
+    before = bridge.metrics.journal_syncs
+    bridge._save_snapshot()
+    assert bridge.metrics.journal_syncs == before + 2
+    bridge.complete()
+    with pytest.raises(ValueError, match="durability"):
+        DeviceStreamBridge(_cfg(), key=0, durability="eventually")
+
+
+def test_durability_survives_recover(tmp_path):
+    ck = str(tmp_path / "ck")
+    bridge = DeviceStreamBridge(
+        _cfg(num_reservoirs=2, max_sample_size=4),
+        key=2,
+        checkpoint_dir=ck,
+        checkpoint_every=2,
+        durability="fsync",
+    )
+    bridge.push(0, np.arange(32, dtype=np.int32))
+    bridge.drain_barrier()
+    del bridge
+    gc.collect()
+    recovered = DeviceStreamBridge.recover(ck)
+    assert recovered._durability == "fsync"  # restored from metadata
+    assert (
+        DeviceStreamBridge.recover(ck, durability="buffered")._durability
+        == "buffered"
+    )
+
+
+# ----------------------------------------------------- rehearsal (hardware)
+
+
+def test_ha_rehearsal_kill_promote_refollow(tmp_path):
+    """One full failover cycle, fault-free — the budget-capped flow the
+    tpu_watch ``ha_rehearsal`` post-step executes on hardware windows:
+    feed, replicate, kill the primary mid-stream, promote, verify the
+    fence and bit-exact snapshots, re-follow, and keep serving."""
+    cfg = _cfg(num_reservoirs=4)
+    ck = str(tmp_path / "ck")
+    primary = ReservoirService(
+        cfg, key=17, checkpoint_dir=ck, checkpoint_every=6, coalesce_bytes=64
+    )
+    standby = StandbyReplica(ck)
+    rng = np.random.default_rng(17)
+    fed = {}
+    for i in range(3):
+        key = f"s{i}"
+        primary.open_session(key)
+        fed[key] = ((i + 1) * 1000 + rng.integers(0, 900, 25)).astype(
+            np.int32
+        )
+        primary.ingest(key, fed[key])
+    primary.sync()
+    standby.poll()
+    # kill: no shutdown, no complete — then promote the warm standby
+    promoted = standby.promote()
+    with pytest.raises(FencedError):
+        primary.sync()
+    for key, elems in fed.items():
+        got = promoted.snapshot(key)
+        sess = promoted.table.route(key)
+        want = _oracle_replay(cfg, 17, promoted.table, sess, elems)
+        np.testing.assert_array_equal(got, want, err_msg=key)
+    # the promoted primary serves and a fresh standby re-follows it
+    promoted.ingest("s0", fed["s0"] + 7)
+    promoted.sync()
+    refollow = StandbyReplica(ck)
+    refollow.poll()
+    np.testing.assert_array_equal(
+        refollow.snapshot("s0"), promoted.snapshot("s0")
+    )
+
+
+# ------------------------------------------------------------- chaos soak
+
+
+@pytest.mark.parametrize("mode", ["plain", "weighted", "distinct"])
+def test_chaos_soak_randomized_kill_promote_refollow(tmp_path, mode):
+    """The ISSUE-5 acceptance soak: 7 randomized kill→promote→re-follow
+    cycles per mode (21 across the matrix) under faults injected at every
+    new site (``replica.ship`` / ``replica.apply`` / ``ha.heartbeat``).
+    After EVERY promotion: per-session snapshots are bit-identical to the
+    per-session oracle replay, the fenced old primary's subsequent ingest
+    raises ``FencedError`` without mutating the journal, and the new
+    standby re-follows the promoted primary."""
+    CYCLES = 7
+    cfg = _cfg(mode, num_reservoirs=4, max_sample_size=3, tile_size=8)
+    ck = str(tmp_path / "ck")
+    plane = FaultPlane(
+        [
+            FaultRule(
+                "replica.ship", exc=TransientDeviceError, after=2, every=5
+            ),
+            FaultRule(
+                "replica.apply", exc=TransientDeviceError, after=1, every=7
+            ),
+            FaultRule("ha.heartbeat", exc=OSError, after=1, every=4),
+        ],
+        seed=11,
+    )
+    seed = 40 + len(mode)
+    primary = ReservoirService(
+        cfg,
+        key=seed,
+        checkpoint_dir=ck,
+        checkpoint_every=9,
+        coalesce_bytes=64,
+        faults=plane,
+    )
+    hb = HeartbeatWriter(ck, service=primary, faults=plane)
+    standby = StandbyReplica(ck, faults=plane)
+    rng = np.random.default_rng(seed)
+    fed: dict = {}  # key -> (elems list, weights list) for the CURRENT lease
+    live: list = []
+    next_id = 0
+    for cycle in range(CYCLES):
+        # randomized traffic: opens (recycling rows), ingests, closes
+        for _ in range(8):
+            op = rng.random()
+            if (op < 0.3 and len(live) < 6) or not live:
+                key = f"s{next_id}"
+                next_id += 1
+                primary.open_session(key)
+                live = [k for k in live if k in primary.table] + [key]
+                fed[key] = ([], [])
+            elif op < 0.85:
+                key = live[int(rng.integers(len(live)))]
+                if key not in primary.table:
+                    live.remove(key)
+                    continue
+                n = int(rng.integers(1, 14))
+                base = (int(key[1:]) + 1) * 10_000
+                elems = (base + rng.integers(0, 5000, n)).astype(np.int32)
+                w = rng.uniform(0.1, 3.0, n).astype(np.float32)
+                primary.ingest(
+                    key, elems, weights=w if mode == "weighted" else None
+                )
+                fed[key][0].extend(elems.tolist())
+                fed[key][1].extend(w.tolist())
+            else:
+                key = live[int(rng.integers(len(live)))]
+                if key in primary.table:
+                    primary.close_session(key)
+                live.remove(key)
+                fed.pop(key, None)
+            if rng.random() < 0.3:
+                try:
+                    hb.beat()  # the injected heartbeat fault fires here
+                except OSError:
+                    pass
+        primary.sync()
+        for _ in range(3):
+            standby.poll()  # injected ship/apply faults retried in-line
+        # KILL the primary (kept alive as the zombie for the fence probe)
+        old, old_hb = primary, hb
+        promoted = standby.promote()
+        # fenced zombie: ingest forcing a flush fails typed, journal
+        # bytes untouched, heartbeat refuses to claim liveness
+        journal_before = _journal_bytes(ck)
+        with pytest.raises(FencedError):
+            old.sync()
+        if live:
+            with pytest.raises(FencedError):
+                old.ingest(
+                    live[-1],
+                    np.arange(64, dtype=np.int32),
+                    weights=(
+                        np.ones(64, np.float32)
+                        if mode == "weighted"
+                        else None
+                    ),
+                )
+                old.sync()
+        assert _journal_bytes(ck) == journal_before
+        assert old.bridge.metrics.fenced_writes >= 1
+        with pytest.raises((FencedError, OSError)):
+            while True:  # first non-injected beat must hit the fence
+                old_hb.beat()
+        # every live session bit-identical to its per-session oracle
+        for key in [s.key for s in promoted.table.sessions()]:
+            got = promoted.snapshot(key)
+            base = (int(key[1:]) + 1) * 10_000
+            assert np.all((got >= base) & (got < base + 5000)), (
+                f"cycle {cycle}: cross-session leakage in {key}: {got}"
+            )
+            sess = promoted.table.route(key)
+            want = _oracle_replay(
+                cfg,
+                seed,
+                promoted.table,
+                sess,
+                np.asarray(fed[key][0], np.int32),
+                (
+                    np.asarray(fed[key][1], np.float32)
+                    if mode == "weighted"
+                    else None
+                ),
+            )
+            np.testing.assert_array_equal(
+                got, want, err_msg=f"cycle {cycle}: {key}"
+            )
+        # re-follow: the promoted primary is the new primary; a fresh
+        # standby tails it into the next cycle
+        primary = promoted
+        hb = HeartbeatWriter(ck, service=primary, faults=plane)
+        standby = StandbyReplica(ck, faults=plane)
+    assert standby.metrics.bootstraps >= 1
+    # the soak exercised every new fault site
+    hits = plane.hits()
+    for site in ("replica.ship", "replica.apply", "ha.heartbeat"):
+        assert hits.get(site, 0) >= CYCLES, (site, hits)

@@ -420,6 +420,51 @@ def test_ttl_sweep_through_service():
     assert svc.snapshot("b").size == 0  # b survived
 
 
+def test_autonomous_ttl_sweep_on_idle_but_queried_service():
+    """The ISSUE-5 satellite: with ``sweep_interval_s`` set, an idle
+    session expires WITHOUT anyone calling ``sweep_expired()`` — the
+    sweep rides the ingest/snapshot/sync entry points opportunistically,
+    so a service that only ever answers queries still sheds leases."""
+    clock = _Clock()
+    svc = ReservoirService(
+        _cfg(), key=0, ttl_s=10.0, sweep_interval_s=2.0
+    )
+    svc._table._clock = clock
+    svc._last_sweep = clock.t
+    svc.open_session("a")
+    clock.t = 1.0
+    svc.snapshot("a")  # under the sweep interval: no sweep yet
+    clock.t = 5.0
+    svc.open_session("b")
+    clock.t = 12.0  # a idle 11s > ttl; b idle 7s
+    svc.snapshot("b")  # the query sweeps a out and revives b
+    assert "a" not in svc.table, "idle-but-queried service kept a dead lease"
+    assert "b" in svc.table
+    assert svc.metrics.evictions == 1
+    # the expired-but-queried key itself: the sweep wins, typed error
+    clock.t = 30.0
+    with pytest.raises(UnknownSessionError):
+        svc.snapshot("b")
+    assert svc.metrics.evictions == 2
+    # ingest is an entry point too
+    svc.open_session("c")
+    svc.ingest("c", np.arange(4, dtype=np.int32))
+    clock.t = 45.0
+    svc.open_session("d")
+    clock.t = 58.0  # c idle 13s > ttl; d idle 13s... both expire
+    svc.open_session("e")
+    svc.ingest("e", np.arange(4, dtype=np.int32))  # sweeps c and d
+    assert "c" not in svc.table and "d" not in svc.table
+    # without sweep_interval_s the behavior stays manual-only (default)
+    svc2 = ReservoirService(_cfg(), key=1, ttl_s=10.0)
+    svc2._table._clock = clock
+    svc2.open_session("x")
+    clock.t += 100.0
+    svc2.open_session("y")
+    svc2.snapshot("y")
+    assert "x" in svc2.table  # nobody swept: manual-only default pinned
+
+
 # ----------------------------------------------- recycling fuzz + recovery
 
 

@@ -380,6 +380,23 @@ def test_serve_soak_post_step_registered():
     assert "serve" in tpu_watch.CONFIG_BUDGETS
 
 
+def test_ha_rehearsal_post_step_registered():
+    # the ISSUE-5 HA post-step: budget-capped, runs the kill→promote→
+    # verify cycle on the native backend, ahead of recovery_rehearsal
+    # (which stays last); the ha bench config rides the capture queue too
+    steps = {name: (cmd, timeout, env) for name, cmd, timeout, env in
+             tpu_watch.POST_STEPS}
+    cmd, timeout, env = steps["ha_rehearsal"]
+    assert "tests/test_ha.py" in cmd
+    assert "-k" in cmd and "rehearsal" in cmd
+    assert 0 < timeout <= 900
+    assert env.get("RESERVOIR_TPU_TEST_PLATFORM") == "native"
+    order = [name for name, *_ in tpu_watch.POST_STEPS]
+    assert order.index("ha_rehearsal") < order.index("recovery_rehearsal")
+    assert "ha" in tpu_watch.DEFAULT_CONFIGS.split(",")
+    assert "ha" in tpu_watch.CONFIG_BUDGETS
+
+
 def test_capture_surfaces_fault_counters(tmp_path, monkeypatch):
     # a bridge evidence row carrying robustness counters must lift them to
     # the capture row's top level, like the tuned geometry
@@ -454,7 +471,7 @@ def test_post_step_rehearsal_sequential_gating(tmp_path, monkeypatch):
     assert any("--kernel weighted" in r for r in ran)
     assert [s[0] for s in remaining] == [
         "distinct_sweep", "pallas_device_tests", "algl_best_block",
-        "serve_soak", "recovery_rehearsal",
+        "serve_soak", "ha_rehearsal", "recovery_rehearsal",
     ]
     assert committed == ["2 post-step(s) recorded"]
     rows = [

@@ -62,6 +62,8 @@ PUBLIC_MODULES = [
     "reservoir_tpu.parallel.multihost",
     "reservoir_tpu.parallel.sharded",
     "reservoir_tpu.serve",
+    "reservoir_tpu.serve.ha",
+    "reservoir_tpu.serve.replica",
     "reservoir_tpu.serve.service",
     "reservoir_tpu.serve.sessions",
     "reservoir_tpu.stream",

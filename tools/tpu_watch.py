@@ -78,6 +78,9 @@ CONFIG_BUDGETS: dict[str, tuple[float, dict[str, str]]] = {
     # the ISSUE-4 serving plane: sessions/sec + live-snapshot latency on
     # the real backend; host-path config, so no embedded parity selftest
     "serve": (420.0, {"RESERVOIR_BENCH_SELFTEST": "0"}),
+    # the ISSUE-5 HA plane: failover-time-ms + replication lag with a hot
+    # standby tailing the journal; host-path config, no parity selftest
+    "ha": (420.0, {"RESERVOIR_BENCH_SELFTEST": "0"}),
 }
 
 # r5 priority order (VERDICT r4): parity-attached headline first, then
@@ -87,7 +90,7 @@ CONFIG_BUDGETS: dict[str, tuple[float, dict[str, str]]] = {
 # a CONFIG_BUDGETS row (an unbudgeted config can burn a whole window).
 DEFAULT_CONFIGS = (
     "algl,algl_chunk1024,algl_chunk0,distinct,weighted,stream,bridge,"
-    "bridge_serial,serve,algl_B4096"
+    "bridge_serial,serve,ha,algl_B4096"
 )
 
 def _now() -> str:
@@ -395,6 +398,24 @@ POST_STEPS: list[tuple[str, list[str], float, dict]] = [
             "soak",
         ],
         900.0,
+        {"RESERVOIR_TPU_TEST_PLATFORM": "native"},
+    ),
+    (
+        # HA rehearsal (ISSUE 5): kill the primary mid-stream, promote the
+        # hot standby, verify the fence + bit-exact snapshots — one full
+        # failover cycle against the real backend, budget-capped
+        "ha_rehearsal",
+        [
+            sys.executable,
+            "-m",
+            "pytest",
+            "tests/test_ha.py",
+            "-q",
+            "--no-header",
+            "-k",
+            "rehearsal",
+        ],
+        600.0,
         {"RESERVOIR_TPU_TEST_PLATFORM": "native"},
     ),
     (
