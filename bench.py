@@ -368,9 +368,11 @@ def _bench_serve(S, k, B, steps, reps):
     batched engine through ``ReservoirService`` — open, ``steps`` rounds of
     coalesced per-session ingest, a live snapshot per session, close.
     Returns the wall times plus a serve stage table: sessions/sec through
-    the full lifecycle and the live-snapshot latency distribution (the two
-    numbers a traffic-facing deployment plans capacity with)."""
-    from reservoir_tpu import SamplerConfig
+    the full lifecycle, plus ingest-admission and live-snapshot latency
+    quantiles sourced from the telemetry registry (ISSUE 6 — the service
+    instruments its own hot paths; the bench just enables the registry and
+    reads the histograms instead of keeping ad-hoc lists)."""
+    from reservoir_tpu import SamplerConfig, obs
     from reservoir_tpu.serve import ReservoirService
 
     cfg = SamplerConfig(max_sample_size=k, num_reservoirs=S, tile_size=B)
@@ -379,7 +381,6 @@ def _bench_serve(S, k, B, steps, reps):
         rng.integers(0, 1 << 31, (S, B), dtype=np.int64).astype(np.int32)
         for _ in range(steps)
     ]
-    snap_ms: list = []
 
     def one_pass(r):
         svc = ReservoirService(cfg, key=r, coalesce_bytes=1 << 20)
@@ -394,29 +395,61 @@ def _bench_serve(S, k, B, steps, reps):
         # hit the flushed_seq-keyed cache — both latencies belong in the
         # distribution (that IS the serving profile)
         for key in keys:
-            t0 = time.perf_counter()
             svc.snapshot(key, sync=False)
-            snap_ms.append((time.perf_counter() - t0) * 1e3)
         for key in keys:
             svc.close_session(key)
         return svc
 
     svc = one_pass(0)  # warm: compiles every flush shape
-    snap_ms.clear()
-    times = []
-    for r in range(1, reps + 1):
-        t0 = time.perf_counter()
-        svc = one_pass(r)
-        times.append(time.perf_counter() - t0)
-    q = np.percentile(np.asarray(snap_ms), [50, 99])
-    stages = {
-        "sessions": S,
-        "sessions_per_sec": S / min(times),
-        "snapshot_p50_ms": round(float(q[0]), 4),
-        "snapshot_p99_ms": round(float(q[1]), 4),
-        "serve": svc.metrics.snapshot(),
-    }
+    # fresh registry AFTER the warm pass: quantiles cover timed reps only
+    reg = obs.enable(obs.Registry())
+    try:
+        times = []
+        for r in range(1, reps + 1):
+            t0 = time.perf_counter()
+            svc = one_pass(r)
+            times.append(time.perf_counter() - t0)
+        snap = reg.histogram("serve.snapshot_s").percentiles()
+        ingest = reg.histogram("serve.ingest_s").percentiles()
+        stages = {
+            "sessions": S,
+            "sessions_per_sec": S / min(times),
+            # registry-sourced (log-spaced buckets, BENCH.md "Telemetry
+            # histogram columns"); column names unchanged from r9
+            "snapshot_p50_ms": round(snap[0] * 1e3, 4),
+            "snapshot_p99_ms": round(snap[1] * 1e3, 4),
+            "snapshot_p999_ms": round(snap[2] * 1e3, 4),
+            "ingest_p50_ms": round(ingest[0] * 1e3, 4),
+            "ingest_p99_ms": round(ingest[1] * 1e3, 4),
+            "ingest_p999_ms": round(ingest[2] * 1e3, 4),
+            "serve": svc.metrics.snapshot(),
+            "telemetry": _telemetry_summary(
+                reg,
+                ("serve.ingest_s", "serve.snapshot_s", "bridge.flush_s",
+                 "serve.coalesce_fill"),
+            ),
+        }
+    finally:
+        obs.disable()
     return times, stages
+
+
+def _telemetry_summary(reg, names):
+    """Compact per-histogram summary for evidence rows (count + quantiles
+    only — the full export is the exporters' job, not the bench's)."""
+    out = {}
+    for name in names:
+        h = reg.histogram(name)
+        if h.count:
+            p50, p99, p999 = h.percentiles()
+            out[name] = {
+                "count": h.count,
+                "p50": p50,
+                "p99": p99,
+                "p999": p999,
+                "max": h.max,
+            }
+    return out
 
 
 def _bench_ha(S, k, B, steps, reps):
@@ -429,11 +462,13 @@ def _bench_ha(S, k, B, steps, reps):
     plans its availability budget with.  The row carries that and the
     steady-state **replication lag** (seq delta + staleness seconds, both
     expected ~0 when the standby polls at the sync cadence; see BENCH.md
-    "HA metrics")."""
+    "HA metrics").  Failover time and lag quantiles are sourced from the
+    telemetry registry (ISSUE 6): the replica observes ``ha.promote_s``
+    and ``replica.lag_*_dist`` itself; the bench reads the histograms."""
     import shutil
     import tempfile
 
-    from reservoir_tpu import SamplerConfig
+    from reservoir_tpu import SamplerConfig, obs
     from reservoir_tpu.serve import ReservoirService, StandbyReplica
 
     cfg = SamplerConfig(max_sample_size=k, num_reservoirs=S, tile_size=B)
@@ -442,8 +477,6 @@ def _bench_ha(S, k, B, steps, reps):
         rng.integers(0, 1 << 31, (S, B), dtype=np.int64).astype(np.int32)
         for _ in range(steps)
     ]
-    failover_ms: list = []
-    lag_rows: list = []
 
     def one_pass(r):
         ckdir = tempfile.mkdtemp(prefix="reservoir_ha_bench_")
@@ -465,37 +498,44 @@ def _bench_ha(S, k, B, steps, reps):
                     svc.ingest(key, chunks[s][i])
                 svc.sync()
                 standby.poll()
-                lag_rows.append(standby.lag())
+                standby.lag()
             svc.shutdown()  # the primary "dies"; promote() is what we time
             del svc
-            t0 = time.perf_counter()
-            promoted = standby.promote()
-            failover_ms.append((time.perf_counter() - t0) * 1e3)
+            promoted = standby.promote()  # observed into ha.promote_s
             promoted.shutdown()
             return standby.metrics
         finally:
             shutil.rmtree(ckdir, ignore_errors=True)
 
     metrics = one_pass(0)  # warm: compiles every flush shape
-    failover_ms.clear()
-    lag_rows.clear()
-    times = []
-    for r in range(1, reps + 1):
-        t0 = time.perf_counter()
-        metrics = one_pass(r)
-        times.append(time.perf_counter() - t0)
-    stages = {
-        "sessions": S,
-        "failover_ms_best": round(min(failover_ms), 3),
-        "failover_ms_median": round(
-            sorted(failover_ms)[len(failover_ms) // 2], 3
-        ),
-        "lag_seq_max": max(l[0] for l in lag_rows),
-        "lag_s_p50": round(
-            float(np.percentile([l[1] for l in lag_rows], 50)), 6
-        ),
-        "ha": metrics.snapshot(),
-    }
+    # fresh registry AFTER the warm pass: quantiles cover timed reps only
+    reg = obs.enable(obs.Registry())
+    try:
+        times = []
+        for r in range(1, reps + 1):
+            t0 = time.perf_counter()
+            metrics = one_pass(r)
+            times.append(time.perf_counter() - t0)
+        promote = reg.histogram("ha.promote_s")
+        stages = {
+            "sessions": S,
+            # min/max are tracked exactly by the histogram; the median is
+            # the bucketed p50 (BENCH.md "Telemetry histogram columns")
+            "failover_ms_best": round(promote.min * 1e3, 3),
+            "failover_ms_median": round(promote.quantile(0.5) * 1e3, 3),
+            "lag_seq_max": int(reg.histogram("replica.lag_seq_dist").max),
+            "lag_s_p50": round(
+                reg.histogram("replica.lag_s_dist").quantile(0.5), 6
+            ),
+            "ha": metrics.snapshot(),
+            "telemetry": _telemetry_summary(
+                reg,
+                ("ha.promote_s", "replica.apply_s", "bridge.flush_s",
+                 "bridge.journal_append_s", "checkpoint.write_s"),
+            ),
+        }
+    finally:
+        obs.disable()
     return times, stages
 
 

@@ -45,6 +45,7 @@ from .ops import algorithm_l as _algl
 from .ops import distinct as _distinct
 from .ops import weighted as _weighted
 from .utils import faults as _faults
+from .utils.log import info_once, warn_once
 
 __all__ = ["ReservoirEngine"]
 
@@ -312,15 +313,15 @@ class ReservoirEngine:
         logs the reason once per engine (VERDICT r3 item 7)."""
         reason = self._pallas_fallback_reason(steady, ragged, tile_dtype)
         if reason is not None and self._config.impl == "pallas":
-            if not self._pallas_fallback_logged:
-                self._pallas_fallback_logged = True
-                import logging
-
-                logging.getLogger(__name__).info(
-                    "impl='pallas' requested but this tile takes the XLA "
-                    "path: %s (logged once per engine)",
-                    reason,
-                )
+            info_once(
+                self,
+                "_pallas_fallback_logged",
+                "impl='pallas' requested but this tile takes the XLA "
+                "path: %s (logged once per engine)",
+                reason,
+                logger=__name__,
+                site="engine.update",
+            )
         return reason is None
 
     def _pallas_fallback_reason(
@@ -402,15 +403,16 @@ class ReservoirEngine:
         geometry = self._kernel_geometry(self._kernel_name(), width, tile_dtype)
         if geometry is None:
             return
-        self._tuned_geometry_ignored_logged = True
-        import logging
-
-        logging.getLogger(__name__).info(
+        info_once(
+            self,
+            "_tuned_geometry_ignored_logged",
             "tuned %s geometry %s for this tile shape is ignored — the "
             "tile takes the XLA path: %s (logged once per engine)",
             self._kernel_name(),
             tuple(geometry),
             self._pallas_fallback_reason(steady, ragged, tile_dtype),
+            logger=__name__,
+            site="engine.update",
         )
 
     def _base_update(self, steady: bool, use_pallas: bool, geometry=None):
@@ -534,16 +536,16 @@ class ReservoirEngine:
     def _demote(self, exc: BaseException) -> None:
         self._demoted = True
         self.demotions += 1
-        if not self._demotion_logged:
-            self._demotion_logged = True
-            import logging
-
-            logging.getLogger(__name__).warning(
-                "Pallas update failed (%s: %s); engine demoted to the XLA "
-                "path — sampling continues (logged once per engine)",
-                type(exc).__name__,
-                exc,
-            )
+        warn_once(
+            self,
+            "_demotion_logged",
+            "Pallas update failed (%s: %s); engine demoted to the XLA "
+            "path — sampling continues (logged once per engine)",
+            type(exc).__name__,
+            exc,
+            logger=__name__,
+            site="engine.pallas",
+        )
 
     def _call_update(self, fn, use_pallas: bool, rebuild_xla, state, args):
         """Run one jitted update, demoting the engine to XLA on a runtime

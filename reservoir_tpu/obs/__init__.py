@@ -1,0 +1,68 @@
+"""Unified telemetry plane (ISSUE 6): metrics registry, latency
+histograms, structured event log, exporters.
+
+The stack below this package measures itself three different ways —
+snapshot-only counter dataclasses (:mod:`reservoir_tpu.utils.metrics`),
+Perfetto trace spans (:mod:`reservoir_tpu.utils.tracing`), and ad-hoc
+bench quantile lists.  This package is the one place they meet:
+
+- :mod:`.registry` — thread-safe named counters/gauges/**log-spaced
+  latency histograms** (exact p50/p99/p99.9 readout), module-global
+  :func:`enable`/:func:`disable` with the fault plane's zero-overhead-
+  when-disabled discipline, and block registration that absorbs the
+  released metric dataclasses into every export;
+- :mod:`.events` — a rate-limited JSON-lines event log with correlation
+  fields (``flush_seq``/``session``/``epoch``/``site``), torn-tail
+  tolerant like ``sessions.jsonl``;
+- :mod:`.export` — Prometheus text format and an atomic JSON snapshot
+  (embedded into ``heartbeat.json`` by the HA plane's
+  :class:`~reservoir_tpu.serve.ha.HeartbeatWriter`, tailed live by
+  ``tools/reservoir_top.py``).
+
+Telemetry is **off by default**: every instrumented hot path costs one
+module-global load and an ``is None`` test until :func:`enable` is called
+(pinned by the trip-wire in ``tests/test_obs.py``)::
+
+    from reservoir_tpu import obs
+
+    reg = obs.enable(event_log_path="/tmp/events.jsonl")
+    ...  # run traffic
+    print(obs.prometheus_text(reg))
+    p50, p99, p999 = reg.histogram("serve.ingest_s").percentiles()
+    obs.disable()
+"""
+
+from .events import EventLog, read_events
+from .export import json_snapshot, prometheus_text, write_json_snapshot
+from .registry import (
+    Counter,
+    Gauge,
+    Histogram,
+    Registry,
+    active,
+    blocks,
+    disable,
+    emit,
+    enable,
+    register_block,
+)
+from .registry import get as get_registry
+
+__all__ = [
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "Registry",
+    "EventLog",
+    "active",
+    "blocks",
+    "disable",
+    "emit",
+    "enable",
+    "get_registry",
+    "json_snapshot",
+    "prometheus_text",
+    "read_events",
+    "register_block",
+    "write_json_snapshot",
+]

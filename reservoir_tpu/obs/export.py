@@ -1,0 +1,152 @@
+"""Exporters: Prometheus text format and a JSON snapshot.
+
+Two render targets over one :class:`~reservoir_tpu.obs.registry.Registry`:
+
+- :func:`prometheus_text` — the Prometheus exposition format (``# TYPE``
+  headers, cumulative ``_bucket{le=...}`` lines for histograms, ``_sum``/
+  ``_count``), golden-pinned by ``tests/test_obs.py`` so the wire format
+  cannot drift silently;
+- :func:`json_snapshot` / :func:`write_json_snapshot` — the machine-local
+  form: one dict carrying the registry snapshot AND every live registered
+  metric block (``BridgeMetrics``/``ServiceMetrics``/``HAMetrics`` via
+  :func:`~reservoir_tpu.obs.registry.register_block`), which is what the
+  heartbeat writer embeds into ``heartbeat.json`` and
+  ``tools/reservoir_top.py`` tails.
+
+Only occupied histogram buckets are emitted (plus the mandatory ``+Inf``):
+a 180-bucket latency histogram with three occupied buckets costs four
+lines, not 181.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+import time
+from typing import Optional
+
+from .registry import Counter, Gauge, Histogram, Registry, blocks, get
+
+__all__ = ["prometheus_text", "json_snapshot", "write_json_snapshot"]
+
+
+def _sanitize(name: str) -> str:
+    return "".join(
+        c if (c.isalnum() or c == "_") else "_" for c in name
+    )
+
+
+def _fmt(v: float) -> str:
+    if isinstance(v, bool):
+        return "1" if v else "0"
+    if isinstance(v, int):
+        return str(v)
+    f = float(v)
+    return str(int(f)) if f.is_integer() and abs(f) < 1e15 else repr(f)
+
+
+def _flatten(prefix: str, d: dict, out: dict) -> None:
+    for key, value in d.items():
+        name = f"{prefix}_{key}" if prefix else str(key)
+        if isinstance(value, dict):
+            _flatten(name, value, out)
+        elif isinstance(value, bool) or isinstance(value, (int, float)):
+            out[name] = value
+
+
+def prometheus_text(
+    registry: Optional[Registry] = None,
+    *,
+    prefix: str = "reservoir",
+    include_blocks: bool = True,
+) -> str:
+    """Render ``registry`` (default: the active one) in Prometheus text
+    exposition format.  ``include_blocks`` additionally renders every live
+    registered metric block's numeric ``snapshot()`` fields as gauges with
+    an ``instance`` label."""
+    if registry is None:
+        registry = get()
+    lines = []
+    if registry is not None:
+        for inst in registry.instruments():
+            name = f"{prefix}_{_sanitize(inst.name)}"
+            if isinstance(inst, Counter):
+                lines.append(f"# TYPE {name} counter")
+                lines.append(f"{name} {_fmt(inst.value)}")
+            elif isinstance(inst, Gauge):
+                lines.append(f"# TYPE {name} gauge")
+                lines.append(f"{name} {_fmt(inst.value)}")
+            elif isinstance(inst, Histogram):
+                lines.append(f"# TYPE {name} histogram")
+                bounds = inst.bounds()
+                counts = inst.bucket_counts()
+                cum = 0
+                for i, c in enumerate(counts[:-1]):
+                    cum += c
+                    if c:
+                        lines.append(
+                            f'{name}_bucket{{le="{bounds[i]:g}"}} {cum}'
+                        )
+                cum += counts[-1]
+                lines.append(f'{name}_bucket{{le="+Inf"}} {cum}')
+                lines.append(f"{name}_sum {_fmt(inst.sum)}")
+                lines.append(f"{name}_count {inst.count}")
+    if include_blocks:
+        by_name: dict = {}
+        for kind, idx, block in blocks():
+            flat: dict = {}
+            _flatten("", block.snapshot(), flat)
+            for field, value in flat.items():
+                name = f"{prefix}_{_sanitize(kind)}_{_sanitize(field)}"
+                by_name.setdefault(name, []).append((idx, value))
+        for name in sorted(by_name):
+            lines.append(f"# TYPE {name} gauge")
+            for idx, value in by_name[name]:
+                lines.append(f'{name}{{instance="{idx}"}} {_fmt(value)}')
+    return "\n".join(lines) + ("\n" if lines else "")
+
+
+def json_snapshot(
+    registry: Optional[Registry] = None,
+    *,
+    include_blocks: bool = True,
+    clock=time.time,
+) -> dict:
+    """One JSON-able dict: registry instruments plus (by default) every
+    live registered metric block, keyed by kind with instance ids —
+    the payload the heartbeat embeds and ``reservoir_top`` renders."""
+    if registry is None:
+        registry = get()
+    out: dict = {"ts": float(clock())}
+    out.update(
+        registry.snapshot()
+        if registry is not None
+        else {"counters": {}, "gauges": {}, "histograms": {}}
+    )
+    if include_blocks:
+        grouped: dict = {}
+        for kind, idx, block in blocks():
+            grouped.setdefault(kind, {})[str(idx)] = block.snapshot()
+        out["blocks"] = grouped
+    return out
+
+
+def write_json_snapshot(
+    path: str, registry: Optional[Registry] = None, **kwargs
+) -> dict:
+    """Atomically write :func:`json_snapshot` to ``path`` (temp file +
+    rename: a tailing ``reservoir_top`` never reads a torn export)."""
+    snap = json_snapshot(registry, **kwargs)
+    directory = os.path.dirname(os.path.abspath(path)) or "."
+    os.makedirs(directory, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=directory, suffix=".tmp.obs")
+    try:
+        with os.fdopen(fd, "w", encoding="utf-8") as fh:
+            json.dump(snap, fh, default=str)
+        os.replace(tmp, path)
+    except BaseException:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+        raise
+    return snap

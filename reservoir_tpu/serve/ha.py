@@ -41,6 +41,8 @@ import time
 from typing import Any, List, Optional
 
 from ..errors import FencedError
+from ..obs import registry as _obs
+from ..obs.export import json_snapshot
 from ..utils import faults as _faults
 from ..utils.checkpoint import read_epoch
 from ..utils.metrics import HAMetrics
@@ -115,6 +117,12 @@ class HeartbeatWriter:
         current = read_epoch(self._dir)
         if current > self._epoch:
             self._metrics.fenced_writes += 1
+            _obs.emit(
+                "ha.fenced",
+                site="ha.heartbeat",
+                epoch=current,
+                own_epoch=self._epoch,
+            )
             raise FencedError(
                 f"heartbeat fenced: {self._dir!r} is at primary epoch "
                 f"{current}, this writer was admitted at {self._epoch}",
@@ -133,6 +141,12 @@ class HeartbeatWriter:
         if self._svc is not None:
             payload["rejections"] = self._svc.metrics.rejections
             payload["sessions_open"] = self._svc.metrics.sessions_open
+        reg = _obs.get()
+        if reg is not None:
+            # unify heartbeat.json with the telemetry plane (ISSUE 6): the
+            # beat carries the SAME export `reservoir_top` and the JSON
+            # exporter produce — one schema, wherever the numbers surface
+            payload["telemetry"] = json_snapshot(reg)
         fd, tmp = tempfile.mkstemp(dir=self._dir, suffix=".tmp.hb")
         try:
             with os.fdopen(fd, "w", encoding="utf-8") as fh:

@@ -55,6 +55,7 @@ from typing import Any, Deque, List, Optional, Tuple
 
 import numpy as np
 
+from ..obs import registry as _obs
 from ..stream.bridge import DeviceStreamBridge, _FlushJournal
 from ..utils import faults as _faults
 from ..utils.checkpoint import (
@@ -63,6 +64,7 @@ from ..utils.checkpoint import (
     read_engine_metadata,
 )
 from ..utils.metrics import HAMetrics
+from ..utils.tracing import trace_span
 from .service import _JOURNAL_NAME, ReservoirService
 from .sessions import SessionTable
 
@@ -243,6 +245,12 @@ class StandbyReplica:
       clock: monotonic time source for staleness accounting (injectable).
       faults: fault plane for the ``replica.*`` sites.
       metrics: shared :class:`HAMetrics` (one is created when omitted).
+      status_path: when set, every :meth:`poll` / :meth:`promote` writes an
+        atomic JSON status file there (applied watermark, lag, promotion
+        state, plus the telemetry JSON export when the registry is
+        enabled) — what ``tools/reservoir_top.py`` tails for the standby
+        half of an HA pair.  Never inside ``checkpoint_dir``: the standby
+        does not write to the primary's durable state.
     """
 
     def __init__(
@@ -255,8 +263,10 @@ class StandbyReplica:
         clock=time.monotonic,
         faults: Optional[Any] = None,
         metrics: Optional[HAMetrics] = None,
+        status_path: Optional[str] = None,
     ) -> None:
         self._dir = checkpoint_dir
+        self._status_path = status_path
         self._map_fn = map_fn
         self._hash_fn = hash_fn
         self._max_records = int(max_records)
@@ -379,6 +389,9 @@ class StandbyReplica:
         self._pending_ops.extend(self._tail_session_ops())
         self._drain_ready_ops()
         self._metrics.bootstraps += 1
+        _obs.emit(
+            "replica.bootstrap", site="replica.ship", flush_seq=covered
+        )
 
     def _read_session_header(self) -> Optional[dict]:
         """Parse and consume the ``base`` header record, when a session
@@ -526,7 +539,14 @@ class StandbyReplica:
                 _faults.fire("replica.apply", self._faults)
                 # the exact replay path recover() uses — bit-exact by
                 # construction (counter-keyed draws)
-                self._engine.sample(tile, valid=valid, weights=wtile)
+                reg = _obs.get()
+                t0 = time.perf_counter() if reg is not None else 0.0
+                with trace_span("reservoir_replica_apply"):
+                    self._engine.sample(tile, valid=valid, weights=wtile)
+                if reg is not None:
+                    reg.histogram("replica.apply_s").observe(
+                        time.perf_counter() - t0
+                    )
                 self._applied_seq = seq
                 self._bridge._flush_seq = seq  # keys the snapshot cache
                 self._follower.advance(seq, end)
@@ -538,6 +558,7 @@ class StandbyReplica:
                 self._last_error = e
                 break
         self._update_lag()
+        self._write_status()
         return applied
 
     def _update_lag(self) -> None:
@@ -555,6 +576,59 @@ class StandbyReplica:
             lag_s = max(0.0, now - since)
         self._metrics.lag_seq = lag_seq
         self._metrics.lag_s = lag_s
+        reg = _obs.get()
+        if reg is not None:
+            # gauges carry the instantaneous lag; histograms accumulate
+            # the distribution over polls (what `bench.py ha` reads)
+            reg.gauge("replica.lag_seq").set(lag_seq)
+            reg.gauge("replica.lag_s").set(lag_s)
+            reg.histogram(
+                "replica.lag_seq_dist", lo=1e-3, hi=1e9, buckets_per_decade=4
+            ).observe(lag_seq)
+            reg.histogram("replica.lag_s_dist").observe(lag_s)
+
+    def _write_status(self) -> None:
+        """Atomic standby status file (``status_path=``): the standby half
+        of what ``reservoir_top`` renders.  Best-effort — a status-write
+        failure must never fail replication."""
+        if self._status_path is None:
+            return
+        payload = {
+            "ts": time.time(),
+            "applied_seq": self._applied_seq,
+            "target_seq": self._target_seq,
+            "lag_seq": self._metrics.lag_seq,
+            "lag_s": self._metrics.lag_s,
+            "bootstraps": self._metrics.bootstraps,
+            "apply_errors": self._metrics.apply_errors,
+            "ship_errors": self._metrics.ship_errors,
+            "promoted": self._promoted,
+            "last_error": (
+                repr(self._last_error) if self._last_error else None
+            ),
+        }
+        reg = _obs.get()
+        if reg is not None:
+            from ..obs.export import json_snapshot
+
+            payload["telemetry"] = json_snapshot(reg)
+        try:
+            import tempfile
+
+            directory = (
+                os.path.dirname(os.path.abspath(self._status_path)) or "."
+            )
+            fd, tmp = tempfile.mkstemp(dir=directory, suffix=".tmp.status")
+            try:
+                with os.fdopen(fd, "w", encoding="utf-8") as fh:
+                    json.dump(payload, fh, default=str)
+                os.replace(tmp, self._status_path)
+            except BaseException:
+                if os.path.exists(tmp):
+                    os.unlink(tmp)
+                raise
+        except OSError:
+            pass
 
     def lag(self) -> Tuple[int, float]:
         """Replication lag as ``(seq_delta, staleness_s)``: flush
@@ -605,6 +679,35 @@ class StandbyReplica:
         """
         if self._promoted:
             raise RuntimeError("this replica was already promoted")
+        reg = _obs.get()
+        t0 = time.perf_counter() if reg is not None else 0.0
+        with trace_span("reservoir_promote"):
+            service = self._promote_steps(
+                checkpoint=checkpoint,
+                checkpoint_every=checkpoint_every,
+                durability=durability,
+                drain_attempts=drain_attempts,
+            )
+        if reg is not None:
+            reg.histogram("ha.promote_s").observe(time.perf_counter() - t0)
+        _obs.emit(
+            "ha.promoted",
+            site="ha.promote",
+            epoch=self._bridge.epoch,
+            flush_seq=self._applied_seq,
+        )
+        self._write_status()
+        return service
+
+    def _promote_steps(
+        self,
+        *,
+        checkpoint: bool,
+        checkpoint_every: Optional[int],
+        durability: Optional[str],
+        drain_attempts: int,
+    ) -> ReservoirService:
+        """The fence/drain/flip sequence (traced as ``reservoir_promote``)."""
         epoch = advance_epoch(self._dir)
         for _ in range(max(1, drain_attempts)):
             errs = self._metrics.ship_errors + self._metrics.apply_errors

@@ -47,11 +47,13 @@ from __future__ import annotations
 
 import json
 import os
+import time
 from typing import Any, List, Optional, Tuple
 
 import numpy as np
 
 from ..config import SamplerConfig
+from ..obs import registry as _obs
 from ..errors import (
     CheckpointMismatch,
     RetryPolicy,
@@ -198,6 +200,7 @@ class ReservoirService:
         # tenant's data into a freshly opened session
         self._snap: Optional[Tuple[np.ndarray, np.ndarray]] = None
         self._snap_key: Optional[Tuple[int, int]] = None
+        self._snap_at = time.monotonic()  # cache fill time (staleness)
         self._reset_epoch = 0
         # session journal (crash recovery of the table itself)
         self._journal_fh = None
@@ -282,6 +285,14 @@ class ReservoirService:
             )
             self._reset_epoch += 1
             self._metrics.recycles += 1
+            _obs.emit(
+                "session.recycle",
+                site="serve.open",
+                session=key,
+                row=sess.row,
+                gen=sess.generation,
+                flush_seq=at_seq,
+            )
         self._append_journal(
             {
                 "op": "open",
@@ -293,6 +304,13 @@ class ReservoirService:
         )
         self._metrics.sessions_opened += 1
         self._metrics.sessions_open = len(self._table)
+        _obs.emit(
+            "session.open",
+            site="serve.open",
+            session=key,
+            row=sess.row,
+            flush_seq=at_seq,
+        )
         return sess
 
     def close_session(self, key: str) -> np.ndarray:
@@ -311,6 +329,13 @@ class ReservoirService:
         )
         self._metrics.closes += 1
         self._metrics.sessions_open = len(self._table)
+        _obs.emit(
+            "session.close",
+            site="serve.close",
+            session=key,
+            row=sess.row,
+            flush_seq=self._bridge.flushed_seq,
+        )
         return final
 
     def _maybe_sweep(self) -> None:
@@ -337,6 +362,13 @@ class ReservoirService:
                 }
             )
             self._metrics.evictions += 1
+            _obs.emit(
+                "session.evict",
+                site="serve.sweep",
+                session=ev.key,
+                row=ev.row,
+                flush_seq=self._bridge.flushed_seq,
+            )
         self._metrics.sessions_open = len(self._table)
         return [ev.key for ev in evicted]
 
@@ -354,6 +386,11 @@ class ReservoirService:
         through the bridge's interleaved demux once ``coalesce_bytes``
         accumulate (or at the next sync/snapshot barrier)."""
         self._maybe_sweep()
+        # telemetry (ISSUE 6): admission latency — accept-path wall time,
+        # including any coalesce-buffer ship this call triggers.  One
+        # global load + None test when disabled (the trip-wire pin).
+        reg = _obs.get()
+        t0 = time.perf_counter() if reg is not None else 0.0
         sess = self._table.route(key)
         try:
             _faults.fire("serve.ingest", self._faults)
@@ -411,6 +448,13 @@ class ReservoirService:
         )
         if saturated and self._pend_bytes + nbytes > self._max_inflight_bytes:
             self._metrics.rejections += 1
+            _obs.emit(
+                "serve.rejected",
+                site="serve.ingest",
+                session=key,
+                pending_bytes=self._pend_bytes + nbytes,
+                flush_seq=self._bridge.flushed_seq,
+            )
             raise ServiceSaturated(
                 f"in-flight bytes {self._pend_bytes + nbytes} over budget "
                 f"{self._max_inflight_bytes} with the flush pipeline "
@@ -426,6 +470,8 @@ class ReservoirService:
         self._metrics.ingested_elements += n
         if self._pend_bytes >= self._coalesce_bytes and not saturated:
             self._flush_pending()
+        if reg is not None:
+            reg.histogram("serve.ingest_s").observe(time.perf_counter() - t0)
         return n
 
     def _retry_hint(self) -> float:
@@ -440,6 +486,14 @@ class ReservoirService:
         mid-batch flush tiles to the device as they do on the raw bridge)."""
         if not self._pend:
             return
+        reg = _obs.get()
+        if reg is not None:
+            # coalesce occupancy: how full the cross-session buffer was
+            # when it shipped (1.0 = exactly at threshold; < 1.0 = a
+            # barrier flushed it early) — the `coalesce_bytes` tuning lever
+            reg.histogram(
+                "serve.coalesce_fill", lo=1e-3, hi=10.0
+            ).observe(self._pend_bytes / self._coalesce_bytes)
         pend, self._pend, self._pend_bytes = self._pend, [], 0
         streams = np.concatenate([p[0] for p in pend])
         elems = np.concatenate([p[1] for p in pend])
@@ -482,6 +536,8 @@ class ReservoirService:
         keyed by ``(flushed_seq, reset_epoch)``: N sessions polling between
         flushes cost ONE device readback, not N."""
         self._maybe_sweep()
+        reg = _obs.get()
+        t0 = time.perf_counter() if reg is not None else 0.0
         sess = self._table.route(key)
         self._table.check(sess)  # generation guard: no stale-row reads
         if sync:
@@ -493,11 +549,25 @@ class ReservoirService:
         if self._snap_key != cache_key:
             self._snap = self._bridge.engine.peek_arrays()
             self._snap_key = cache_key
+            self._snap_at = time.monotonic()
             self._metrics.snapshot_misses += 1
         else:
             self._metrics.snapshot_hits += 1
         samples, sizes = self._snap
-        return samples[sess.row, : int(sizes[sess.row])].copy()
+        out = samples[sess.row, : int(sizes[sess.row])].copy()
+        if reg is not None:
+            # sync=True reads pay a flush barrier — a different latency
+            # population than the live cache-read path; keep the two
+            # histograms separate so `snapshot_p*` stays the live number
+            reg.histogram(
+                "serve.snapshot_sync_s" if sync else "serve.snapshot_s"
+            ).observe(time.perf_counter() - t0)
+            # staleness: age of the device->host snapshot this read was
+            # served from (0-ish on a miss; grows while the cache serves)
+            reg.histogram("serve.snapshot_staleness_s").observe(
+                time.monotonic() - self._snap_at
+            )
+        return out
 
     # ------------------------------------------------------------- recovery
 

@@ -64,8 +64,10 @@ from ..errors import (
     SamplerClosedError,
 )
 from ..native import NativeStaging
+from ..obs import registry as _obs
 from ..utils import faults as _faults
 from ..utils.checkpoint import read_epoch
+from ..utils.log import warn_once
 from ..utils.metrics import BridgeMetrics
 from ..utils.tracing import trace_span
 
@@ -329,7 +331,13 @@ class _FlushJournal:
         self._fh = open(path, "ab")
 
     def _sync(self) -> None:
+        reg = _obs.get()  # telemetry (ISSUE 6): the durability tax, alone
+        t0 = time.perf_counter() if reg is not None else 0.0
         os.fsync(self._fh.fileno())
+        if reg is not None:
+            reg.histogram("bridge.journal_fsync_s").observe(
+                time.perf_counter() - t0
+            )
         if self._sync_cb is not None:
             self._sync_cb()
 
@@ -773,7 +781,7 @@ class DeviceStreamBridge:
                 else None
             )
             self._flush_seq += 1
-            self._journal.append(
+            self._journal_append(
                 self._flush_seq,
                 np.ascontiguousarray(tile),
                 valid_arr,
@@ -813,7 +821,14 @@ class DeviceStreamBridge:
                 self._engine.sample(tile, valid=valid, weights=wtile)
             else:
                 self._engine.sample(tile, valid=valid)
-        self._metrics.dispatch_s += time.perf_counter() - t0
+        dt = time.perf_counter() - t0
+        self._metrics.dispatch_s += dt
+        reg = _obs.get()  # telemetry (ISSUE 6): one load + None test when off
+        if reg is not None:
+            reg.histogram("bridge.flush_s").observe(dt)
+            reg.histogram("bridge.flush_bytes", lo=1.0, hi=1e12).observe(
+                tile.nbytes + (wtile.nbytes if wtile is not None else 0)
+            )
         # surface graceful degradation: a mid-stream Pallas->XLA demotion
         # happens inside the engine; mirror it onto the bridge counters
         self._metrics.demotions = self._engine.demotions
@@ -847,7 +862,7 @@ class DeviceStreamBridge:
             # recover() replays it and no flushed element is ever lost
             self._flush_seq += 1
             if self._journal is not None:
-                self._journal.append(self._flush_seq, tile, valid, wtile)
+                self._journal_append(self._flush_seq, tile, valid, wtile)
             if self._pipeline is not None:
                 # wait until the OTHER tile's previous flight is done,
                 # then swap the demux onto it
@@ -882,7 +897,7 @@ class DeviceStreamBridge:
             return
         self._flush_seq += 1
         if self._journal is not None:
-            self._journal.append(self._flush_seq, tile, valid, wtile)
+            self._journal_append(self._flush_seq, tile, valid, wtile)
         if self._pipeline is not None:
             self._pipeline.submit(tile, valid, wtile)
             self._buf = 1 - i  # demux continues into the other tile
@@ -891,6 +906,19 @@ class DeviceStreamBridge:
         self._metrics.flushes += 1
         self._metrics.flushed_elements += total
         self._maybe_checkpoint()
+
+    def _journal_append(self, seq, tile, valid, wtile) -> None:
+        """Journal one flushed tile — traced (``reservoir_journal_append``
+        shows up in Perfetto next to the flush span) and, when telemetry
+        is enabled, timed into the ``bridge.journal_append_s`` histogram."""
+        reg = _obs.get()
+        t0 = time.perf_counter() if reg is not None else 0.0
+        with trace_span("reservoir_journal_append"):
+            self._journal.append(seq, tile, valid, wtile)
+        if reg is not None:
+            reg.histogram("bridge.journal_append_s").observe(
+                time.perf_counter() - t0
+            )
 
     def drain_barrier(self) -> None:
         """Wait for any in-flight pipelined flush (re-raising its error)."""
@@ -950,6 +978,13 @@ class DeviceStreamBridge:
         current = self._current_epoch()
         if current > self._epoch:
             self._metrics.fenced_writes += 1
+            _obs.emit(
+                "bridge.fenced",
+                site="bridge.flush",
+                epoch=current,
+                own_epoch=self._epoch,
+                flush_seq=self._flush_seq,
+            )
             raise FencedError(
                 f"bridge fenced: checkpoint dir {self._ckpt_dir!r} is at "
                 f"primary epoch {current}, this bridge was admitted at "
@@ -1016,6 +1051,12 @@ class DeviceStreamBridge:
         )
         self._journal.rotate()
         self._metrics.checkpoints += 1
+        _obs.emit(
+            "bridge.checkpoint",
+            site="checkpoint.write",
+            flush_seq=self._flush_seq,
+            epoch=self._epoch,
+        )
 
     def _maybe_checkpoint(self) -> None:
         if self._journal is None or self._flush_seq % self._ckpt_every:
@@ -1032,17 +1073,17 @@ class DeviceStreamBridge:
             # checkpoint is intact (atomic write) and the journal keeps
             # growing from it, so recover() still reconstructs everything —
             # sampling continues
-            if not self._ckpt_failed_logged:
-                self._ckpt_failed_logged = True
-                import logging
-
-                logging.getLogger(__name__).warning(
-                    "auto-checkpoint failed (%s: %s); sampling continues, "
-                    "recovery will replay the longer journal (logged once "
-                    "per bridge)",
-                    type(e).__name__,
-                    e,
-                )
+            warn_once(
+                self,
+                "_ckpt_failed_logged",
+                "auto-checkpoint failed (%s: %s); sampling continues, "
+                "recovery will replay the longer journal (logged once "
+                "per bridge)",
+                type(e).__name__,
+                e,
+                logger=__name__,
+                site="checkpoint.write",
+            )
 
     @classmethod
     def recover(
@@ -1145,6 +1186,13 @@ class DeviceStreamBridge:
             if replay_hook is not None:
                 replay_hook(bridge, seq)
         m.recoveries += 1
+        _obs.emit(
+            "bridge.recovered",
+            site="bridge.recover",
+            flush_seq=bridge._flush_seq,
+            replayed=bridge._flush_seq - covered,
+            epoch=bridge._epoch,
+        )
         return bridge
 
     # ------------------------------------------------------------ completion

@@ -39,13 +39,16 @@ import dataclasses
 import json
 import os
 import tempfile
+import time
 import zipfile
 from typing import Any, Callable, Optional, Tuple
 
 import numpy as np
 
 from ..errors import CheckpointCorrupt, CheckpointMismatch
+from ..obs import registry as _obs
 from . import faults
+from .tracing import trace_span
 
 __all__ = [
     "save_state",
@@ -315,7 +318,15 @@ def save_engine(path: str, engine, metadata: Optional[dict] = None) -> None:
             "device_count": jax.device_count(),
         },
     }
-    _atomic_write_npz(path, arrays, manifest)
+    # telemetry (ISSUE 6): the write is traced (Perfetto shows
+    # `reservoir_checkpoint_write` next to the flush spans) and, when the
+    # registry is enabled, timed into `checkpoint.write_s`
+    reg = _obs.get()
+    t0 = time.perf_counter() if reg is not None else 0.0
+    with trace_span("reservoir_checkpoint_write"):
+        _atomic_write_npz(path, arrays, manifest)
+    if reg is not None:
+        reg.histogram("checkpoint.write_s").observe(time.perf_counter() - t0)
 
 
 def read_engine_metadata(path: str) -> dict:

@@ -14,6 +14,8 @@ import dataclasses
 import time
 from typing import Dict, Optional
 
+from ..obs import registry as _obs
+
 
 @dataclasses.dataclass
 class ServiceMetrics:
@@ -39,6 +41,12 @@ class ServiceMetrics:
     rejections: int = 0
     ingested_elements: int = 0
     recoveries: int = 0
+
+    def __post_init__(self) -> None:
+        # absorb into the telemetry plane (ISSUE 6): exporters render every
+        # live block; construction-time only, the counters stay plain
+        # attributes (released signature + single-writer contract unchanged)
+        _obs.register_block("serve", self)
 
     def snapshot(self) -> Dict[str, float]:
         """Point-in-time dict view (the bench/capture row format)."""
@@ -83,6 +91,9 @@ class HAMetrics:
     applied_ops: int = 0
     bootstraps: int = 0
     heartbeats: int = 0
+
+    def __post_init__(self) -> None:
+        _obs.register_block("ha", self)  # exporter view; counters unchanged
 
     def snapshot(self) -> Dict[str, float]:
         """Point-in-time dict view (the bench/capture row format)."""
@@ -148,6 +159,9 @@ class BridgeMetrics:
     # sets it post-construction.
     demux_threads: int = dataclasses.field(default=1, init=False)
     _t0: Optional[float] = None
+
+    def __post_init__(self) -> None:
+        _obs.register_block("bridge", self)  # exporter view; unchanged block
 
     def start(self) -> None:
         if self._t0 is None:

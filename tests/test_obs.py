@@ -1,0 +1,556 @@
+"""Telemetry plane (ISSUE 6): registry, histograms, event log, exporters.
+
+The contract under test, in the order the ISSUE lists it:
+
+- disabled telemetry is a zero-overhead no-op on the bridge flush path —
+  the same trip-wire discipline the fault plane pins (no Registry method
+  is ever entered, no instrument allocated, no event written);
+- histogram buckets are a deterministic pure function of the constructor
+  args, and bucketed quantiles track numpy percentiles within one
+  log-bucket's relative width;
+- the event log tolerates a torn tail exactly like ``sessions.jsonl``
+  and rate-limits without losing count of what it dropped;
+- the Prometheus text export is golden-pinned;
+- the instrumented stack (bridge/service/replica/ha) actually feeds the
+  registry, the heartbeat embeds the export, and ``reservoir_top``
+  renders a live service and an HA pair (lag + fence state).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import sys
+
+import numpy as np
+import pytest
+
+from reservoir_tpu import SamplerConfig, obs
+from reservoir_tpu.obs import (
+    EventLog,
+    Histogram,
+    Registry,
+    json_snapshot,
+    prometheus_text,
+    read_events,
+)
+from reservoir_tpu.obs import registry as obs_registry
+from reservoir_tpu.stream.bridge import DeviceStreamBridge
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(_REPO, "tools"))
+import reservoir_top  # noqa: E402
+
+sys.path.pop(0)
+
+
+@pytest.fixture(autouse=True)
+def _telemetry_disabled():
+    # every test starts and ends with telemetry off — the disabled state
+    # is the suite-wide default the zero-overhead trip-wire pins
+    obs.disable()
+    yield
+    obs.disable()
+
+
+def _cfg(R=4, B=16, k=4, **kw):
+    return SamplerConfig(
+        max_sample_size=k, num_reservoirs=R, tile_size=B, **kw
+    )
+
+
+# --------------------------------------------------------------- instruments
+
+
+class TestHistogram:
+    def test_bucket_bounds_are_deterministic(self):
+        h = Histogram("h", lo=1e-3, hi=10.0, buckets_per_decade=1)
+        assert h.bounds() == pytest.approx([1e-2, 1e-1, 1.0, 10.0])
+        # same args -> same geometry, independent of observation order
+        h2 = Histogram("h2", lo=1e-3, hi=10.0, buckets_per_decade=1)
+        assert h2.bounds() == h.bounds()
+
+    def test_bucket_mapping_edges(self):
+        h = Histogram("h", lo=1e-3, hi=10.0, buckets_per_decade=1)
+        for v in (0.0, 1e-9, 1e-3):  # at-or-below lo: first bucket
+            h.observe(v)
+        h.observe(0.005)  # (1e-3, 1e-2]
+        h.observe(5.0)  # (1, 10]
+        h.observe(1e6)  # > hi: overflow bucket
+        assert h.bucket_counts() == [4, 0, 0, 1, 1]
+        assert h.count == 6
+        assert h.max == 1e6 and h.min == 0.0
+
+    def test_same_observations_same_counts(self):
+        rng = np.random.default_rng(7)
+        vals = rng.lognormal(-7, 1, 500)
+        a, b = Histogram("a"), Histogram("b")
+        for v in vals:
+            a.observe(v)
+        for v in vals[::-1]:  # order must not matter
+            b.observe(v)
+        assert a.bucket_counts() == b.bucket_counts()
+
+    def test_single_observation_reads_back_exactly(self):
+        h = Histogram("h")
+        h.observe(0.0123)
+        for q in (0.5, 0.99, 0.999):
+            assert h.quantile(q) == 0.0123
+        snap = h.snapshot()
+        assert snap["count"] == 1 and snap["p50"] == 0.0123
+
+    def test_quantiles_track_numpy_percentiles(self):
+        # log-spaced buckets bound the relative quantile error by one
+        # bucket's width (10**(1/20) ~ 12% at the default geometry)
+        rng = np.random.default_rng(0)
+        vals = rng.lognormal(-7, 1, 4000)
+        h = Histogram("h")
+        for v in vals:
+            h.observe(v)
+        for q in (0.5, 0.9, 0.99, 0.999):
+            want = float(np.percentile(vals, q * 100))
+            got = h.quantile(q)
+            assert 0.8 <= got / want <= 1.25, (q, got, want)
+        assert h.sum == pytest.approx(float(vals.sum()))
+        assert h.min == float(vals.min()) and h.max == float(vals.max())
+
+    def test_empty_histogram_reads_zero(self):
+        h = Histogram("h")
+        assert h.quantile(0.99) == 0.0
+        assert h.snapshot()["count"] == 0
+
+    def test_overflow_quantile_is_observed_max(self):
+        h = Histogram("h", lo=1e-3, hi=1.0, buckets_per_decade=1)
+        for _ in range(10):
+            h.observe(123.0)
+        assert h.quantile(0.5) == 123.0
+
+
+class TestRegistry:
+    def test_get_or_create_returns_shared_instrument(self):
+        reg = Registry()
+        c = reg.counter("x")
+        c.inc(2)
+        assert reg.counter("x") is c
+        assert reg.counter("x").value == 2
+
+    def test_kind_conflict_raises(self):
+        reg = Registry()
+        reg.counter("x")
+        with pytest.raises(TypeError):
+            reg.gauge("x")
+
+    def test_snapshot_groups_by_kind(self):
+        reg = Registry()
+        reg.counter("c").inc()
+        reg.gauge("g").set(3.5)
+        reg.histogram("h").observe(0.1)
+        snap = reg.snapshot()
+        assert snap["counters"] == {"c": 1}
+        assert snap["gauges"] == {"g": 3.5}
+        assert snap["histograms"]["h"]["count"] == 1
+
+    def test_enable_disable_roundtrip(self, tmp_path):
+        assert obs_registry.get() is None
+        reg = obs.enable(event_log_path=str(tmp_path / "ev.jsonl"))
+        assert obs_registry.get() is reg
+        assert obs.emit("x", flush_seq=1) is True
+        obs.disable()
+        assert obs_registry.get() is None
+        assert obs.emit("x") is False  # no-op again
+        assert [r["event"] for r in read_events(
+            str(tmp_path / "ev.jsonl")
+        )] == ["x"]
+
+    def test_active_restores_previous(self):
+        with obs.active() as reg:
+            assert obs_registry.get() is reg
+        assert obs_registry.get() is None
+
+    def test_register_block_prunes_dead_refs(self):
+        class Block:
+            def snapshot(self):
+                return {"v": 1}
+
+        b = Block()
+        obs.register_block("test_kind", b)
+        assert any(k == "test_kind" for k, _, _ in obs_registry.blocks())
+        del b
+        import gc
+
+        gc.collect()
+        assert not any(
+            k == "test_kind" for k, _, _ in obs_registry.blocks()
+        )
+
+
+# ----------------------------------------------------------------- trip-wire
+
+
+def test_disabled_telemetry_is_zero_overhead_noop(monkeypatch, tmp_path):
+    # the disabled fast path must never enter ANY Registry instrument
+    # accessor or the event log: with no registry enabled, a trip-wired
+    # stack proves every instrumented site short-circuits on the
+    # module-global None check — the faults-plane discipline, mirrored
+    assert obs_registry.get() is None
+
+    def tripwire(self, *a, **k):  # pragma: no cover - would fail the test
+        raise AssertionError("telemetry touched with the registry disabled")
+
+    for method in ("counter", "gauge", "histogram"):
+        monkeypatch.setattr(Registry, method, tripwire)
+    monkeypatch.setattr(EventLog, "emit", tripwire)
+    # a full checkpointing bridge stream: demux, zero-copy flush, journal
+    # append, dispatch, auto-checkpoint, complete
+    bridge = DeviceStreamBridge(
+        _cfg(), key=2, checkpoint_dir=str(tmp_path), checkpoint_every=1
+    )
+    for _ in range(3):
+        bridge.push(0, np.arange(16, dtype=np.int32))
+    bridge.complete()
+    # and the serving plane's ingest/snapshot/close paths
+    from reservoir_tpu.serve import ReservoirService
+
+    svc = ReservoirService(_cfg())
+    svc.open_session("a")
+    svc.ingest("a", np.arange(32, dtype=np.int32))
+    svc.snapshot("a")
+    svc.close_session("a")
+
+
+# ----------------------------------------------------------------- event log
+
+
+class _FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+class TestEventLog:
+    def test_emit_and_read(self, tmp_path):
+        path = str(tmp_path / "ev.jsonl")
+        log = EventLog(path, clock=_FakeClock())
+        log.emit("flush", flush_seq=7, site="bridge.dispatch")
+        log.emit("open", session="u1", epoch=2)
+        log.close()
+        records = read_events(path)
+        assert [r["event"] for r in records] == ["flush", "open"]
+        assert records[0]["flush_seq"] == 7
+        assert records[1]["session"] == "u1" and records[1]["epoch"] == 2
+
+    def test_torn_tail_is_tolerated(self, tmp_path):
+        path = str(tmp_path / "ev.jsonl")
+        log = EventLog(path)
+        log.emit("a")
+        log.emit("b")
+        log.close()
+        with open(path, "a", encoding="utf-8") as fh:
+            fh.write('{"ts": 1, "event": "torn...')  # crash mid-append
+        records = read_events(path)
+        assert [r["event"] for r in records] == ["a", "b"]
+
+    def test_mid_file_corruption_raises(self, tmp_path):
+        path = str(tmp_path / "ev.jsonl")
+        with open(path, "w", encoding="utf-8") as fh:
+            fh.write('{"event": "a"}\ngarbage\n{"event": "b"}\n')
+        with pytest.raises(ValueError, match="line 2"):
+            read_events(path)
+
+    def test_rate_limit_drops_and_summarizes(self, tmp_path):
+        clock = _FakeClock()
+        path = str(tmp_path / "ev.jsonl")
+        log = EventLog(path, rate_limit_hz=2.0, burst=2, clock=clock)
+        admitted = [log.emit("hot") for _ in range(5)]
+        assert admitted == [True, True, False, False, False]
+        assert log.dropped == {"hot": 3}
+        clock.t += 1.0  # refill 2 tokens
+        assert log.emit("hot") is True
+        log.close()
+        events = read_events(path)
+        # the drop summary lands BEFORE the next admitted record
+        assert [r["event"] for r in events] == [
+            "hot", "hot", "telemetry.dropped", "hot",
+        ]
+        assert events[2]["counts"] == {"hot": 3}
+
+
+# ----------------------------------------------------------------- exporters
+
+
+def test_prometheus_export_golden():
+    reg = Registry()
+    reg.counter("bridge.flushes").inc(3)
+    reg.gauge("replica.lag_seq").set(2)
+    h = reg.histogram("bridge.flush_s", lo=1e-3, hi=10.0, buckets_per_decade=1)
+    h.observe(0.005)
+    h.observe(0.5)
+    golden = (
+        "# TYPE reservoir_bridge_flush_s histogram\n"
+        'reservoir_bridge_flush_s_bucket{le="0.01"} 1\n'
+        'reservoir_bridge_flush_s_bucket{le="1"} 2\n'
+        'reservoir_bridge_flush_s_bucket{le="+Inf"} 2\n'
+        "reservoir_bridge_flush_s_sum 0.505\n"
+        "reservoir_bridge_flush_s_count 2\n"
+        "# TYPE reservoir_bridge_flushes counter\n"
+        "reservoir_bridge_flushes 3\n"
+        "# TYPE reservoir_replica_lag_seq gauge\n"
+        "reservoir_replica_lag_seq 2\n"
+    )
+    assert prometheus_text(reg, include_blocks=False) == golden
+
+
+def test_prometheus_export_renders_metric_blocks():
+    from reservoir_tpu.utils.metrics import BridgeMetrics
+
+    m = BridgeMetrics()
+    m.flushes = 5
+    text = prometheus_text(Registry())
+    rows = [
+        line for line in text.splitlines()
+        if line.startswith("reservoir_bridge_flushes{")
+    ]
+    assert any(" 5" in r for r in rows)  # this block is among the live ones
+    del m
+
+
+def test_json_snapshot_shape(tmp_path):
+    from reservoir_tpu.obs import write_json_snapshot
+
+    reg = Registry()
+    reg.histogram("h").observe(0.25)
+    path = str(tmp_path / "telemetry.json")
+    snap = write_json_snapshot(path, reg)
+    assert snap["histograms"]["h"]["count"] == 1
+    with open(path, encoding="utf-8") as fh:
+        on_disk = json.load(fh)
+    assert on_disk["histograms"]["h"]["count"] == 1
+    assert "blocks" in on_disk and "ts" in on_disk
+
+
+# ----------------------------------------------------- centralized warn_once
+
+
+class TestWarnOnce:
+    def test_logs_once_per_owner(self, caplog):
+        from reservoir_tpu.utils.log import warn_once
+
+        class Owner:
+            _flag = False
+
+        a, b = Owner(), Owner()
+        with caplog.at_level(logging.WARNING, logger="test.log"):
+            assert warn_once(a, "_flag", "boom %d", 1, logger="test.log")
+            assert not warn_once(a, "_flag", "boom %d", 2, logger="test.log")
+            assert warn_once(b, "_flag", "boom %d", 3, logger="test.log")
+        assert [r.getMessage() for r in caplog.records] == [
+            "boom 1", "boom 3",
+        ]
+
+    def test_mirrors_into_event_log_when_enabled(self, tmp_path, caplog):
+        from reservoir_tpu.utils.log import warn_once
+
+        class Owner:
+            pass
+
+        path = str(tmp_path / "ev.jsonl")
+        with obs.active(event_log_path=path):
+            with caplog.at_level(logging.WARNING, logger="test.log"):
+                warn_once(
+                    Owner(), "_f", "bad %s", "thing",
+                    logger="test.log", site="engine.pallas",
+                )
+        events = read_events(path)
+        assert events[0]["event"] == "log"
+        assert events[0]["message"] == "bad thing"
+        assert events[0]["site"] == "engine.pallas"
+        assert events[0]["level"] == "warning"
+
+    def test_rate_limited_logger_suppresses(self, caplog):
+        from reservoir_tpu.utils.log import RateLimited
+
+        clock = _FakeClock()
+        rl = RateLimited("test.rl", min_interval_s=5.0, clock=clock)
+        with caplog.at_level(logging.WARNING, logger="test.rl"):
+            assert rl.warning("x %d", 1)
+            assert not rl.warning("x %d", 2)
+            assert not rl.warning("x %d", 3)
+            clock.t += 6.0
+            assert rl.warning("x %d", 4)
+        msgs = [r.getMessage() for r in caplog.records]
+        assert msgs[0] == "x 1"
+        assert "2 similar suppressed" in msgs[1]
+
+
+# ------------------------------------------------- instrumented stack wiring
+
+
+def test_bridge_flush_path_feeds_registry(tmp_path):
+    with obs.active(event_log_path=str(tmp_path / "ev.jsonl")) as reg:
+        bridge = DeviceStreamBridge(
+            _cfg(), key=3,
+            checkpoint_dir=str(tmp_path / "ck"), checkpoint_every=2,
+            durability="fsync",
+        )
+        for _ in range(4):
+            bridge.push(0, np.arange(16, dtype=np.int32))
+        bridge.complete()
+        flush = reg.histogram("bridge.flush_s")
+        assert flush.count == bridge.metrics.flushes > 0
+        assert reg.histogram("bridge.flush_bytes", lo=1.0, hi=1e12).count > 0
+        assert reg.histogram("bridge.journal_append_s").count > 0
+        # fsync durability: the per-frame sync is timed separately — the
+        # durability tax alone, next to the append it rides on
+        assert reg.histogram("bridge.journal_fsync_s").count > 0
+        assert reg.histogram("checkpoint.write_s").count > 0
+        events = read_events(str(tmp_path / "ev.jsonl"))
+        ck = [e for e in events if e["event"] == "bridge.checkpoint"]
+        # the seq-0 anchor plus at least one periodic checkpoint
+        assert any(e["flush_seq"] >= 2 for e in ck) and "epoch" in ck[0]
+
+
+def test_service_ingest_snapshot_feed_registry(tmp_path):
+    from reservoir_tpu.serve import ReservoirService
+
+    with obs.active(event_log_path=str(tmp_path / "ev.jsonl")) as reg:
+        svc = ReservoirService(_cfg(R=4, B=16), coalesce_bytes=64)
+        svc.open_session("u1")
+        for _ in range(4):
+            svc.ingest("u1", np.arange(32, dtype=np.int32))
+        svc.snapshot("u1")
+        svc.snapshot("u1", sync=False)
+        svc.close_session("u1")
+        assert reg.histogram("serve.ingest_s").count == 4
+        assert reg.histogram("serve.snapshot_s").count >= 1  # live reads
+        assert reg.histogram("serve.snapshot_sync_s").count >= 1
+        assert reg.histogram("serve.snapshot_staleness_s").count >= 2
+        assert reg.histogram("serve.coalesce_fill", lo=1e-3, hi=10.0).count > 0
+        events = read_events(str(tmp_path / "ev.jsonl"))
+        kinds = [e["event"] for e in events]
+        assert "session.open" in kinds and "session.close" in kinds
+        opened = next(e for e in events if e["event"] == "session.open")
+        assert opened["session"] == "u1" and "flush_seq" in opened
+
+
+def test_fenced_bridge_emits_event(tmp_path):
+    from reservoir_tpu.errors import FencedError
+    from reservoir_tpu.utils.checkpoint import advance_epoch
+
+    with obs.active(event_log_path=str(tmp_path / "ev.jsonl")):
+        bridge = DeviceStreamBridge(
+            _cfg(), key=1, checkpoint_dir=str(tmp_path / "ck")
+        )
+        advance_epoch(str(tmp_path / "ck"))
+        bridge.push(0, np.arange(8, dtype=np.int32))  # row stays partial
+        with pytest.raises(FencedError):
+            bridge.flush()
+        events = read_events(str(tmp_path / "ev.jsonl"))
+        fenced = [e for e in events if e["event"] == "bridge.fenced"]
+        assert fenced and fenced[0]["epoch"] == 1
+        assert fenced[0]["own_epoch"] == 0
+        bridge.fail(RuntimeError("fenced teardown"))
+
+
+# --------------------------------------------------------- ha + reservoir_top
+
+
+def _ha_pair(tmp_path, reg_path=None):
+    """A live primary service + heartbeat + polling standby, telemetry on."""
+    from reservoir_tpu.serve import (
+        HeartbeatWriter,
+        ReservoirService,
+        StandbyReplica,
+    )
+
+    ckdir = str(tmp_path / "ck")
+    svc = ReservoirService(
+        _cfg(R=4, B=16),
+        checkpoint_dir=ckdir,
+        checkpoint_every=1 << 30,
+        coalesce_bytes=64,
+    )
+    svc.open_session("u1")
+    svc.ingest("u1", np.arange(64, dtype=np.int32))
+    svc.sync()
+    standby = StandbyReplica(
+        ckdir, status_path=str(tmp_path / "standby.json")
+    )
+    standby.poll()
+    hb = HeartbeatWriter(ckdir, service=svc)
+    hb.beat()
+    return svc, standby, hb, ckdir
+
+
+def test_heartbeat_embeds_telemetry_export(tmp_path):
+    with obs.active() as reg:
+        reg.histogram("serve.ingest_s")  # ensure the registry is live
+        svc, standby, hb, ckdir = _ha_pair(tmp_path)
+        with open(os.path.join(ckdir, "heartbeat.json")) as fh:
+            payload = json.load(fh)
+        assert "telemetry" in payload
+        assert payload["telemetry"]["histograms"]["serve.ingest_s"][
+            "count"
+        ] >= 1
+        assert "blocks" in payload["telemetry"]
+        svc.shutdown()
+
+
+def test_standby_status_file_and_lag_instruments(tmp_path):
+    with obs.active() as reg:
+        svc, standby, hb, ckdir = _ha_pair(tmp_path)
+        with open(str(tmp_path / "standby.json")) as fh:
+            status = json.load(fh)
+        assert status["applied_seq"] == standby.applied_seq
+        assert status["lag_seq"] == 0 and status["promoted"] is False
+        assert reg.histogram("replica.apply_s").count >= 1
+        assert reg.gauge("replica.lag_seq").value == 0
+        svc.shutdown()
+
+
+def test_reservoir_top_renders_service_and_ha_pair(tmp_path, capsys):
+    with obs.active() as reg:
+        svc, standby, hb, ckdir = _ha_pair(tmp_path)
+        frame = reservoir_top.render(
+            reservoir_top.collect(ckdir, str(tmp_path / "standby.json"))
+        )
+        # primary line: watermark + fence ok; standby line: lag visible;
+        # latency table: the instrumented histograms
+        assert f"seq={svc.flushed_seq}" in frame
+        assert "fence: ok" in frame
+        assert "standby: applied_seq=" in frame and "lag_seq=0" in frame
+        assert "ingest admission" in frame
+        assert "flush (device dispatch)" in frame
+        # the CLI entry point (--once) renders the same frame
+        rc = reservoir_top.main(
+            [ckdir, "--standby", str(tmp_path / "standby.json"), "--once"]
+        )
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "fence: ok" in out and "standby:" in out
+
+        # promote the standby: the old primary's heartbeat (epoch 0) is
+        # now behind the persisted epoch -> the pair renders as FENCED
+        svc.shutdown()
+        del svc
+        promoted = standby.promote()
+        frame = reservoir_top.render(
+            reservoir_top.collect(ckdir, str(tmp_path / "standby.json"))
+        )
+        assert "** FENCED" in frame
+        assert "PROMOTED: applied_seq=" in frame
+        assert reg.histogram("ha.promote_s").count == 1
+        promoted.shutdown()
+
+
+def test_reservoir_top_renders_raw_snapshot_file(tmp_path):
+    from reservoir_tpu.obs import write_json_snapshot
+
+    reg = Registry()
+    reg.histogram("serve.ingest_s").observe(0.001)
+    path = str(tmp_path / "telemetry.json")
+    write_json_snapshot(path, reg, include_blocks=False)
+    frame = reservoir_top.render(reservoir_top.collect(path))
+    assert "ingest admission" in frame and "NO HEARTBEAT" in frame

@@ -56,6 +56,10 @@ PUBLIC_MODULES = [
     "reservoir_tpu.ops.u64e",
     "reservoir_tpu.ops.weighted",
     "reservoir_tpu.ops.weighted_pallas",
+    "reservoir_tpu.obs",
+    "reservoir_tpu.obs.events",
+    "reservoir_tpu.obs.export",
+    "reservoir_tpu.obs.registry",
     "reservoir_tpu.oracle",
     "reservoir_tpu.parallel",
     "reservoir_tpu.parallel.merge",
@@ -72,6 +76,7 @@ PUBLIC_MODULES = [
     "reservoir_tpu.stream.operator",
     "reservoir_tpu.utils.checkpoint",
     "reservoir_tpu.utils.faults",
+    "reservoir_tpu.utils.log",
     "reservoir_tpu.utils.metrics",
     "reservoir_tpu.utils.selftest",
     "reservoir_tpu.utils.tracing",

@@ -1,0 +1,274 @@
+"""reservoir_top: live status view over the telemetry plane (ISSUE 6).
+
+A ``top``-style terminal view of a running :class:`ReservoirService` — and,
+when a standby status file is given, of the whole HA pair.  It reads ONLY
+files (no jax import, no backend touch, safe to run next to a live
+process):
+
+- ``<dir>/heartbeat.json`` — the primary's beacon
+  (:class:`~reservoir_tpu.serve.ha.HeartbeatWriter`), which embeds the
+  telemetry JSON export when the registry is enabled;
+- ``<dir>/epoch.json`` — the persisted fence epoch: a heartbeat carrying
+  an older epoch renders as **FENCED** (a standby was promoted; the
+  writer is a zombie);
+- ``--standby PATH`` — the standby's status file
+  (``StandbyReplica(status_path=...)``): applied watermark, replication
+  lag, promotion state;
+- or a plain telemetry snapshot written by
+  ``reservoir_tpu.obs.write_json_snapshot`` when ``<dir>`` is a file.
+
+Usage::
+
+    python tools/reservoir_top.py /path/to/checkpoint_dir \
+        [--standby /path/to/standby.json] [--interval 1.0] [--once] [--plain]
+
+``--once`` prints a single plain-text frame and exits (what the tests
+drive); the default is a curses loop falling back to a plain-text loop
+when no TTY/curses is available.  Flush/ingest rates are derived from
+successive frames (counter deltas over wall time).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from typing import Optional
+
+__all__ = ["collect", "render", "main"]
+
+#: Histograms worth a latency row, in display order.
+_LATENCY_ROWS = (
+    ("bridge.flush_s", "flush (device dispatch)"),
+    ("bridge.journal_append_s", "journal append"),
+    ("bridge.journal_fsync_s", "journal fsync"),
+    ("checkpoint.write_s", "checkpoint write"),
+    ("serve.ingest_s", "ingest admission"),
+    ("serve.snapshot_s", "snapshot read"),
+    ("serve.snapshot_staleness_s", "snapshot staleness"),
+    ("replica.apply_s", "replica apply"),
+    ("ha.promote_s", "promote (failover)"),
+)
+
+
+def _read_json(path: str) -> Optional[dict]:
+    try:
+        with open(path, encoding="utf-8") as fh:
+            return json.load(fh)
+    except (OSError, json.JSONDecodeError, ValueError):
+        return None
+
+
+def collect(target: str, standby_path: Optional[str] = None) -> dict:
+    """Gather one status sample from the on-disk surfaces.  ``target`` is
+    a checkpoint directory (heartbeat/epoch) or a telemetry JSON file."""
+    status: dict = {"ts": time.time(), "target": target}
+    if os.path.isdir(target):
+        status["heartbeat"] = _read_json(
+            os.path.join(target, "heartbeat.json")
+        )
+        epoch = _read_json(os.path.join(target, "epoch.json"))
+        status["persisted_epoch"] = (
+            int(epoch["epoch"]) if epoch and "epoch" in epoch else 0
+        )
+        hb = status["heartbeat"]
+        status["telemetry"] = (hb or {}).get("telemetry")
+    else:
+        status["heartbeat"] = None
+        status["persisted_epoch"] = None
+        status["telemetry"] = _read_json(target)
+    if standby_path is not None:
+        status["standby"] = _read_json(standby_path)
+        if status["telemetry"] is None and status["standby"] is not None:
+            status["telemetry"] = status["standby"].get("telemetry")
+    return status
+
+
+def _fence_line(status: dict) -> str:
+    hb = status.get("heartbeat")
+    persisted = status.get("persisted_epoch")
+    if hb is None:
+        return "primary: NO HEARTBEAT"
+    age = status["ts"] - float(hb.get("ts", 0.0))
+    epoch = int(hb.get("epoch", 0))
+    line = (
+        f"primary: seq={hb.get('seq', '?')} epoch={epoch} "
+        f"beat {age:.1f}s ago"
+    )
+    if persisted is not None and persisted > epoch:
+        line += f"  ** FENCED (persisted epoch {persisted}) **"
+    else:
+        line += "  fence: ok"
+    return line
+
+
+def _rate_lines(status: dict, prev: Optional[dict]) -> list:
+    """Counter deltas between frames -> rates (needs two samples)."""
+    lines = []
+    hb, phb = status.get("heartbeat"), (prev or {}).get("heartbeat")
+    if hb and phb:
+        dt = status["ts"] - prev["ts"]
+        if dt > 0 and "seq" in hb and "seq" in phb:
+            lines.append(
+                f"  flush rate: {(hb['seq'] - phb['seq']) / dt:8.1f} flush/s"
+            )
+    return lines
+
+
+def _fmt_ms(v: float) -> str:
+    return f"{v * 1e3:9.3f}ms"
+
+
+def render(status: dict, prev: Optional[dict] = None) -> str:
+    """One plain-text frame (pure function of the collected samples)."""
+    lines = [
+        f"reservoir_top — {status['target']}  "
+        f"@ {time.strftime('%H:%M:%S', time.localtime(status['ts']))}",
+        _fence_line(status),
+    ]
+    hb = status.get("heartbeat")
+    if hb:
+        lines.append(
+            "health: "
+            f"watchdog_trips={hb.get('watchdog_trips', 0)} "
+            f"demotions={hb.get('demotions', 0)} "
+            f"failures={hb.get('failures', 0)} "
+            f"rejections={hb.get('rejections', 0)} "
+            f"sessions_open={hb.get('sessions_open', '—')}"
+        )
+    lines.extend(_rate_lines(status, prev))
+    sb = status.get("standby")
+    if sb is not None:
+        state = "PROMOTED" if sb.get("promoted") else "standby"
+        lines.append(
+            f"{state}: applied_seq={sb.get('applied_seq', '?')} "
+            f"lag_seq={sb.get('lag_seq', '?')} "
+            f"lag_s={float(sb.get('lag_s', 0.0)):.3f} "
+            f"bootstraps={sb.get('bootstraps', '?')} "
+            f"errors={int(sb.get('ship_errors', 0)) + int(sb.get('apply_errors', 0))}"
+        )
+    tel = status.get("telemetry")
+    if tel:
+        hists = tel.get("histograms", {})
+        rows = [
+            (label, hists[name])
+            for name, label in _LATENCY_ROWS
+            if hists.get(name, {}).get("count")
+        ]
+        if rows:
+            lines.append("")
+            lines.append(
+                f"{'latency':<24}{'count':>8}{'p50':>12}{'p99':>12}"
+                f"{'p99.9':>12}{'max':>12}"
+            )
+            for label, h in rows:
+                lines.append(
+                    f"{label:<24}{int(h['count']):>8}"
+                    f"{_fmt_ms(h['p50']):>12}{_fmt_ms(h['p99']):>12}"
+                    f"{_fmt_ms(h['p999']):>12}{_fmt_ms(h['max']):>12}"
+                )
+        gauges = tel.get("gauges", {})
+        if gauges:
+            lines.append("")
+            lines.append(
+                "gauges: "
+                + "  ".join(
+                    f"{k}={v:g}" for k, v in sorted(gauges.items())
+                )
+            )
+        counters = tel.get("counters", {})
+        if counters:
+            lines.append(
+                "counters: "
+                + "  ".join(
+                    f"{k}={v:g}" for k, v in sorted(counters.items())
+                )
+            )
+        bridges = (tel.get("blocks") or {}).get("bridge") or {}
+        if bridges:
+            flushes = sum(b.get("flushes", 0) for b in bridges.values())
+            elements = sum(b.get("elements", 0) for b in bridges.values())
+            demotions = sum(b.get("demotions", 0) for b in bridges.values())
+            lines.append(
+                f"bridges[{len(bridges)}]: flushes={flushes:g} "
+                f"elements={elements:g} demotions={demotions:g}"
+            )
+    if not hb and not status.get("standby") and not tel:
+        lines.append("(nothing to show yet — is the service beating?)")
+    return "\n".join(lines)
+
+
+def _loop_plain(args) -> int:
+    prev = None
+    try:
+        while True:
+            status = collect(args.target, args.standby)
+            frame = render(status, prev)
+            print("\x1b[2J\x1b[H" + frame, flush=True)
+            prev = status
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        return 0
+
+
+def _loop_curses(args) -> int:
+    import curses
+
+    def run(stdscr) -> None:
+        curses.curs_set(0)
+        stdscr.nodelay(True)
+        prev = None
+        while True:
+            status = collect(args.target, args.standby)
+            frame = render(status, prev)
+            stdscr.erase()
+            maxy, maxx = stdscr.getmaxyx()
+            for y, line in enumerate(frame.splitlines()[: maxy - 1]):
+                stdscr.addnstr(y, 0, line, maxx - 1)
+            stdscr.refresh()
+            prev = status
+            if stdscr.getch() in (ord("q"), 27):
+                return
+            time.sleep(args.interval)
+
+    curses.wrapper(run)
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument(
+        "target",
+        help="checkpoint dir (heartbeat.json/epoch.json) or a telemetry "
+        "JSON snapshot file",
+    )
+    ap.add_argument(
+        "--standby",
+        default=None,
+        help="standby status file (StandbyReplica(status_path=...))",
+    )
+    ap.add_argument("--interval", type=float, default=1.0)
+    ap.add_argument(
+        "--once", action="store_true", help="print one frame and exit"
+    )
+    ap.add_argument(
+        "--plain",
+        action="store_true",
+        help="plain-text loop (no curses) even on a TTY",
+    )
+    args = ap.parse_args(argv)
+    if args.once:
+        print(render(collect(args.target, args.standby)))
+        return 0
+    if not args.plain and sys.stdout.isatty():
+        try:
+            return _loop_curses(args)
+        except Exception:
+            pass  # no curses/TTY quirks: fall through to plain
+    return _loop_plain(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

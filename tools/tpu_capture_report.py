@@ -99,6 +99,28 @@ def report(rows) -> str:
                 f"ks={_flag(st.get('ks_ok', res.get('ks_ok')))} [{src}]"
             )
 
+    # telemetry quantiles (ISSUE 6): serve/ha rows lifted by the watcher
+    # carry registry-sourced latency histograms — render them next to the
+    # throughput table so an evidence write-up never re-digs the JSON
+    telemetry_rows = [
+        (rec, rec["telemetry"])
+        for _, rec, _ in captures
+        if isinstance(rec.get("telemetry"), dict)
+    ]
+    if telemetry_rows:
+        out.append("")
+        out.append("Telemetry (registry histograms, ms):")
+        for rec, tel in telemetry_rows:
+            for name in sorted(tel):
+                h = tel[name]
+                out.append(
+                    f"- `{rec.get('config')}` {name}: "
+                    f"p50={h.get('p50', 0) * 1e3:.3f} "
+                    f"p99={h.get('p99', 0) * 1e3:.3f} "
+                    f"p99.9={h.get('p999', 0) * 1e3:.3f} "
+                    f"(n={h.get('count', 0)}) [{(rec.get('ts') or '')[:19]}]"
+                )
+
     # the chunk A/B verdict (VERDICT r4 item 2) — valid only when both
     # rows come from the SAME capture file (same round / kernel state);
     # cross-file comparisons are flagged, never prescribed

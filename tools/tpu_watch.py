@@ -236,6 +236,13 @@ def capture_bench(
         # recoveries/demotions): a bridge row earned through retries or a
         # demoted kernel must say so at the row's top level
         rec["fault_counters"] = parsed["stages"]["faults"]
+    if isinstance(parsed, dict) and isinstance(
+        parsed.get("stages", {}).get("telemetry"), dict
+    ):
+        # telemetry histogram summary (ISSUE 6): serve/ha rows carry the
+        # registry-sourced latency quantiles at the row's top level, like
+        # geometry and fault_counters before them
+        rec["telemetry"] = parsed["stages"]["telemetry"]
     _append(rec)
     if proc.returncode != 0 or parsed is None:
         if "backend unreachable" in proc.stderr:
