@@ -17,7 +17,17 @@ bench quantile lists.  This package is the one place they meet:
 - :mod:`.export` — Prometheus text format and an atomic JSON snapshot
   (embedded into ``heartbeat.json`` by the HA plane's
   :class:`~reservoir_tpu.serve.ha.HeartbeatWriter`, tailed live by
-  ``tools/reservoir_top.py``).
+  ``tools/reservoir_top.py``);
+- :mod:`.slo` — declarative :class:`~reservoir_tpu.obs.slo.SLOSpec`
+  objectives (latency quantile, staleness, error rate, sample quality)
+  judged by an :class:`~reservoir_tpu.obs.slo.SLOPlane` with
+  Google-SRE-style multi-window burn rates — ``ok``/``warn``/``page``
+  verdicts riding every export (ISSUE 7);
+- :mod:`.audit` — the online
+  :class:`~reservoir_tpu.obs.audit.SampleQualityAuditor`: rolling pooled
+  KS against the uniform law plus per-stratum inclusion-rate counters,
+  feeding the ``sample_quality`` objective so statistical drift pages
+  like a latency regression.
 
 Telemetry is **off by default**: every instrumented hot path costs one
 module-global load and an ``is None`` test until :func:`enable` is called
@@ -47,6 +57,8 @@ from .registry import (
     register_block,
 )
 from .registry import get as get_registry
+from .audit import SampleQualityAuditor
+from .slo import SLOPlane, SLOSpec, SLOVerdict, default_slos
 
 __all__ = [
     "Counter",
@@ -54,8 +66,13 @@ __all__ = [
     "Histogram",
     "Registry",
     "EventLog",
+    "SLOPlane",
+    "SLOSpec",
+    "SLOVerdict",
+    "SampleQualityAuditor",
     "active",
     "blocks",
+    "default_slos",
     "disable",
     "emit",
     "enable",

@@ -106,8 +106,25 @@ class EventLog:
 
     def close(self) -> None:
         with self._lock:
-            if not self._fh.closed:
-                self._fh.close()
+            if self._fh.closed:
+                return
+            if self._dropped:
+                # a storm that never subsided before shutdown would lose
+                # its drop counts: flush the summary the next admitted
+                # record would have carried
+                self._fh.write(
+                    json.dumps(
+                        {
+                            "ts": self._clock(),
+                            "event": "telemetry.dropped",
+                            "counts": self._dropped,
+                        },
+                        sort_keys=True,
+                    )
+                    + "\n"
+                )
+                self._dropped = {}
+            self._fh.close()
 
     def __del__(self) -> None:
         fh = getattr(self, "_fh", None)
@@ -122,18 +139,29 @@ def read_events(path: str) -> List[dict]:
     """Parse an event log.  A torn FINAL line (crash mid-append) is
     dropped — the same tolerance the session journal extends to its tail;
     corruption anywhere earlier raises (the file did not get that way by
-    crashing, and silently skipping records would hide it)."""
-    with open(path, encoding="utf-8") as fh:
-        lines = fh.read().splitlines()
+    crashing, and silently skipping records would hide it) naming the
+    line number AND the byte offset of the bad record, so ``dd``/``tail
+    -c`` can jump straight to it in a multi-gigabyte log."""
+    with open(path, "rb") as fh:
+        data = fh.read()
+    raw_lines = data.split(b"\n")
+    if raw_lines and raw_lines[-1] == b"":
+        raw_lines.pop()  # the trailing newline of a clean final record
     records: List[dict] = []
-    for i, line in enumerate(lines):
-        line = line.strip()
+    offset = 0
+    for i, raw in enumerate(raw_lines):
+        line_offset = offset
+        offset += len(raw) + 1
+        line = raw.strip()
         if not line:
             continue
         try:
-            records.append(json.loads(line))
-        except json.JSONDecodeError:
-            if i == len(lines) - 1:
+            records.append(json.loads(line.decode("utf-8")))
+        except (json.JSONDecodeError, UnicodeDecodeError) as e:
+            if i == len(raw_lines) - 1:
                 break  # torn tail: the event it described never landed
-            raise ValueError(f"{path!r}: corrupt event log at line {i + 1}")
+            raise ValueError(
+                f"{path!r}: corrupt event log at line {i + 1} "
+                f"(byte offset {line_offset}): {e}"
+            ) from None
     return records

@@ -92,6 +92,27 @@ def prometheus_text(
                 lines.append(f'{name}_bucket{{le="+Inf"}} {cum}')
                 lines.append(f"{name}_sum {_fmt(inst.sum)}")
                 lines.append(f"{name}_count {inst.count}")
+    plane = getattr(registry, "slo_plane", None) if registry else None
+    if plane is not None:
+        # burn-rate verdicts (obs/slo.py): one gauge triple per objective,
+        # verdict encoded 0/1/2 (ok/warn/page) so alert rules are a simple
+        # threshold over reservoir_slo_verdict
+        severity = {"ok": 0, "warn": 1, "page": 2}
+        slo = plane.snapshot()
+        verdicts = slo.get("verdicts", {})
+        if verdicts:
+            for metric, value_of in (
+                ("verdict", lambda v: severity.get(v["verdict"], 0)),
+                ("burn_short", lambda v: v["burn_short"]),
+                ("burn_long", lambda v: v["burn_long"]),
+            ):
+                name = f"{prefix}_slo_{metric}"
+                lines.append(f"# TYPE {name} gauge")
+                for key in sorted(verdicts):
+                    lines.append(
+                        f'{name}{{slo="{_sanitize(key)}"}} '
+                        f"{_fmt(value_of(verdicts[key]))}"
+                    )
     if include_blocks:
         by_name: dict = {}
         for kind, idx, block in blocks():
@@ -129,6 +150,11 @@ def json_snapshot(
         for kind, idx, block in blocks():
             grouped.setdefault(kind, {})[str(idx)] = block.snapshot()
         out["blocks"] = grouped
+    plane = getattr(registry, "slo_plane", None) if registry else None
+    if plane is not None:
+        # the verdict panel payload: rides heartbeat.json via the
+        # HeartbeatWriter's embedded export, rendered by reservoir_top
+        out["slo"] = plane.snapshot()
     return out
 
 

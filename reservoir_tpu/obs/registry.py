@@ -244,6 +244,9 @@ class Registry:
         self._lock = threading.Lock()
         self._instruments: Dict[str, object] = {}
         self.event_log = event_log
+        # the SLO plane binds itself here (obs/slo.py) so exporters can
+        # render burn-rate verdicts without new wiring at every call site
+        self.slo_plane = None
 
     def _get(self, name: str, cls, *args, **kwargs):
         inst = self._instruments.get(name)
@@ -274,6 +277,12 @@ class Registry:
         buckets_per_decade: int = 20,
     ) -> Histogram:
         return self._get(name, Histogram, lo, hi, buckets_per_decade)
+
+    def peek(self, name: str) -> Optional[object]:
+        """The instrument named ``name``, or ``None`` — never creates.
+        Readers that must not geometry-default a histogram into existence
+        before its owning site does (the SLO plane) use this."""
+        return self._instruments.get(name)
 
     def instruments(self) -> List[object]:
         with self._lock:

@@ -147,6 +147,12 @@ class HeartbeatWriter:
             # beat carries the SAME export `reservoir_top` and the JSON
             # exporter produce — one schema, wherever the numbers surface
             payload["telemetry"] = json_snapshot(reg)
+            slo = payload["telemetry"].get("slo")
+            if isinstance(slo, dict) and slo.get("verdicts"):
+                # the worst burn-rate verdict rides the beat's top level
+                # (ISSUE 7): the standby-side controller reads health from
+                # the heartbeat alone, and an SLO page is a health signal
+                payload["slo_worst"] = slo.get("worst", "ok")
         fd, tmp = tempfile.mkstemp(dir=self._dir, suffix=".tmp.hb")
         try:
             with os.fdopen(fd, "w", encoding="utf-8") as fh:
@@ -270,6 +276,13 @@ class FailoverController:
                 )
             elif rejections:
                 degraded.append(f"degraded: {rejections} rejections")
+            worst = hb.get("slo_worst")
+            if worst in ("warn", "page"):
+                # burn-rate verdicts (ISSUE 7) are health signals, never
+                # promote triggers on their own: a slow-but-alive primary
+                # is still a primary (same posture as demotions), and a
+                # failover would not fix a biased sampler anyway
+                degraded.append(f"degraded: SLO {worst}")
         return HealthReport(
             healthy=not promote and not degraded,
             should_promote=bool(promote),

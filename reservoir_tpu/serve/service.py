@@ -123,6 +123,13 @@ class ReservoirService:
         service sheds expired leases without anyone calling
         :meth:`sweep_expired` manually.  ``None`` (default) keeps sweeps
         manual-only.
+      auditor: optional online
+        :class:`~reservoir_tpu.obs.audit.SampleQualityAuditor` (ISSUE 7):
+        when set, every accepted ingest feeds its stratum ledger and
+        every snapshot read feeds its rolling KS pool, lighting up the
+        ``audit.*`` instruments the ``sample_quality`` SLO judges.  Both
+        hooks are zero-overhead no-ops while telemetry is disabled
+        (pinned by the trip-wire in ``tests/test_obs.py``).
       pipelined / retry_policy / flush_timeout_s / checkpoint_dir /
         checkpoint_every / durability / faults: forwarded to the
         underlying :class:`DeviceStreamBridge` (the ISSUE-3/5 robustness
@@ -143,6 +150,7 @@ class ReservoirService:
         max_inflight_bytes: int = 1 << 24,
         retry_after_s: float = 0.05,
         sweep_interval_s: Optional[float] = None,
+        auditor: Optional[Any] = None,
         pipelined: bool = True,
         retry_policy: Optional[RetryPolicy] = None,
         flush_timeout_s: Optional[float] = None,
@@ -187,6 +195,7 @@ class ReservoirService:
         self._sweep_interval_s = (
             float(sweep_interval_s) if sweep_interval_s is not None else None
         )
+        self._auditor = auditor
         self._last_sweep = self._table._clock()
         self._metrics = ServiceMetrics()
         self._metrics.sessions_open = len(self._table)
@@ -389,8 +398,27 @@ class ReservoirService:
         # telemetry (ISSUE 6): admission latency — accept-path wall time,
         # including any coalesce-buffer ship this call triggers.  One
         # global load + None test when disabled (the trip-wire pin).
+        # ISSUE 7 adds the error-rate SLO's event counters: every call
+        # into serve.ingest_total, every typed failure/rejection into
+        # serve.ingest_errors — the pair the ingest_error_rate objective
+        # burns against.
         reg = _obs.get()
         t0 = time.perf_counter() if reg is not None else 0.0
+        try:
+            n = self._ingest_impl(key, elements, weights)
+        except (SessionIngestError, ServiceSaturated):
+            if reg is not None:
+                reg.counter("serve.ingest_total").inc()
+                reg.counter("serve.ingest_errors").inc()
+            raise
+        if reg is not None:
+            reg.counter("serve.ingest_total").inc()
+            reg.histogram("serve.ingest_s").observe(time.perf_counter() - t0)
+        return n
+
+    def _ingest_impl(
+        self, key: str, elements: Any, weights: Optional[Any]
+    ) -> int:
         sess = self._table.route(key)
         try:
             _faults.fire("serve.ingest", self._faults)
@@ -468,10 +496,12 @@ class ReservoirService:
         self._pend_bytes += nbytes
         sess.elements += n
         self._metrics.ingested_elements += n
+        if self._auditor is not None:
+            # sample-quality plane (ISSUE 7): stratum ingest ledger —
+            # a no-op (one global load, one None test) while obs is off
+            self._auditor.record_ingest(key, arr)
         if self._pend_bytes >= self._coalesce_bytes and not saturated:
             self._flush_pending()
-        if reg is not None:
-            reg.histogram("serve.ingest_s").observe(time.perf_counter() - t0)
         return n
 
     def _retry_hint(self) -> float:
@@ -555,6 +585,14 @@ class ReservoirService:
             self._metrics.snapshot_hits += 1
         samples, sizes = self._snap
         out = samples[sess.row, : int(sizes[sess.row])].copy()
+        if self._auditor is not None and sync:
+            # sample-quality plane (ISSUE 7): rolling KS pool + stratum
+            # inclusion counts; n is this session's own stream length.
+            # Only the read-your-writes path feeds the auditor — a
+            # sync=False read can trail sess.elements by the coalesce
+            # backlog, which would register as low-position bias that the
+            # sampler never committed.
+            self._auditor.observe_snapshot(key, out, sess.elements)
         if reg is not None:
             # sync=True reads pay a flush barrier — a different latency
             # population than the live cache-read path; keep the two
@@ -581,6 +619,7 @@ class ReservoirService:
         max_inflight_bytes: int = 1 << 24,
         retry_after_s: float = 0.05,
         sweep_interval_s: Optional[float] = None,
+        auditor: Optional[Any] = None,
         pipelined: Optional[bool] = None,
         retry_policy: Optional[RetryPolicy] = None,
         flush_timeout_s: Optional[float] = None,
@@ -679,6 +718,7 @@ class ReservoirService:
             max_inflight_bytes=max_inflight_bytes,
             retry_after_s=retry_after_s,
             sweep_interval_s=sweep_interval_s,
+            auditor=auditor,
             faults=faults,
             checkpoint_dir=checkpoint_dir,
             _bridge=bridge,

@@ -63,6 +63,43 @@ def test_bench_ha_row_reports_failover_and_lag():
     assert stages["ha"]["fenced_writes"] == 0  # clean handoff: no zombie
 
 
+def test_bench_traffic_row_reports_wait_staleness_and_slo_verdicts():
+    # the ISSUE-7 acceptance: `bench.py traffic` must run the open-loop
+    # loadgen end-to-end on CPU and its row must carry the corrected-wait
+    # + latency + staleness quantiles AND the SLO verdicts, in the stable
+    # column names watcher captures parse
+    rec = _run_bench({"RESERVOIR_BENCH_CONFIG": "traffic"})
+    assert "traffic_loadgen" in rec["metric"]
+    assert rec["wait_p99_ms"] >= 0 and rec["staleness_p99_ms"] >= 0
+    assert set(rec["slo"]) == {
+        "ingest_latency_p99", "snapshot_latency_p99",
+        "snapshot_staleness_p99", "ingest_error_rate", "sample_quality",
+    }
+    assert all(v in ("ok", "warn", "page") for v in rec["slo"].values())
+    assert rec["slo_worst"] in ("ok", "warn", "page")
+    stages = rec["stages"]
+    for col in (
+        "sessions", "capacity", "arrivals", "target_rate", "achieved_rate",
+        "completed", "rejected", "errors", "reopens", "elements",
+        "wait_p50_ms", "wait_p99_ms", "wait_p999_ms",
+        "ingest_p50_ms", "ingest_p99_ms", "ingest_p999_ms",
+        "snapshot_p50_ms", "snapshot_p99_ms", "snapshot_p999_ms",
+        "staleness_p50_ms", "staleness_p99_ms",
+    ):
+        assert col in stages, col
+    # the universe overcommits the table: eviction pressure is structural
+    assert stages["sessions"] > stages["capacity"]
+    assert stages["completed"] > 0 and stages["elements"] > 0
+    # per-objective detail rows carry the burn-rate evidence
+    for name, v in stages["slo"].items():
+        assert v["verdict"] == rec["slo"][name]
+        assert "burn_short" in v and "burn_long" in v and "objective" in v
+    # the online auditor actually audited (canary positions -> KS checks)
+    assert stages["audit"]["ks_checks"] >= 1
+    # telemetry sub-dict rides the row like serve/ha stages (r11 contract)
+    assert "loadgen.wait_s" in stages["telemetry"]
+
+
 def test_bench_rejects_unknown_config():
     env = dict(os.environ)
     env.update(RESERVOIR_BENCH_SMOKE="1", RESERVOIR_BENCH_CONFIG="nope")

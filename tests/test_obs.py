@@ -209,10 +209,17 @@ def test_disabled_telemetry_is_zero_overhead_noop(monkeypatch, tmp_path):
     for _ in range(3):
         bridge.push(0, np.arange(16, dtype=np.int32))
     bridge.complete()
-    # and the serving plane's ingest/snapshot/close paths
+    # and the serving plane's ingest/snapshot/close paths — WITH the
+    # sample-quality auditor attached (ISSUE 7): its hooks must also
+    # short-circuit on the module-global None check, so a production
+    # service can keep an auditor wired permanently at zero cost
+    from reservoir_tpu.obs.audit import SampleQualityAuditor
     from reservoir_tpu.serve import ReservoirService
 
-    svc = ReservoirService(_cfg())
+    auditor = SampleQualityAuditor()
+    for method in ("_record", "_observe", "_check"):
+        monkeypatch.setattr(SampleQualityAuditor, method, tripwire)
+    svc = ReservoirService(_cfg(), auditor=auditor)
     svc.open_session("a")
     svc.ingest("a", np.arange(32, dtype=np.int32))
     svc.snapshot("a")
@@ -259,6 +266,51 @@ class TestEventLog:
             fh.write('{"event": "a"}\ngarbage\n{"event": "b"}\n')
         with pytest.raises(ValueError, match="line 2"):
             read_events(path)
+
+    def test_corruption_message_pins_line_and_byte_offset(self, tmp_path):
+        # the ISSUE-7 satellite: mid-file corruption must name the byte
+        # offset of the bad record alongside its line number, so dd/tail
+        # can jump straight to it in a multi-gigabyte log
+        path = str(tmp_path / "ev.jsonl")
+        first = '{"event": "a", "pad": "xyz"}\n'
+        with open(path, "w", encoding="utf-8") as fh:
+            fh.write(first + "garbage{\n" + '{"event": "b"}\n')
+        with pytest.raises(
+            ValueError,
+            match=rf"corrupt event log at line 2 \(byte offset {len(first)}\)",
+        ):
+            read_events(path)
+
+    def test_injectable_clock_pins_refill_granularity(self, tmp_path):
+        # drop/refill behavior is a pure function of the injected clock
+        # (the ISSUE-7 satellite): a full-burst refill readmits exactly
+        # `burst` events, and a sub-token refill admits nothing
+        clock = _FakeClock()
+        log = EventLog(
+            str(tmp_path / "ev.jsonl"), rate_limit_hz=5.0, burst=2,
+            clock=clock,
+        )
+        assert [log.emit("e") for _ in range(3)] == [True, True, False]
+        clock.t += 0.5  # >= a full burst at 5 Hz: the cap makes it exact
+        assert [log.emit("e") for _ in range(3)] == [True, True, False]
+        clock.t += 0.1  # half a token: still dry
+        assert log.emit("e") is False
+        clock.t += 1.0  # plenty: back to a full (capped) burst
+        assert [log.emit("e") for _ in range(3)] == [True, True, False]
+        log.close()
+
+    def test_close_flushes_pending_drop_summary(self, tmp_path):
+        # a storm that never subsides before shutdown must not lose its
+        # drop counts: close() writes the final telemetry.dropped record
+        clock = _FakeClock()
+        path = str(tmp_path / "ev.jsonl")
+        log = EventLog(path, rate_limit_hz=1.0, burst=1, clock=clock)
+        assert log.emit("hot") is True
+        assert [log.emit("hot") for _ in range(4)] == [False] * 4
+        log.close()
+        events = read_events(path)
+        assert [e["event"] for e in events] == ["hot", "telemetry.dropped"]
+        assert events[1]["counts"] == {"hot": 4}
 
     def test_rate_limit_drops_and_summarizes(self, tmp_path):
         clock = _FakeClock()
@@ -554,3 +606,99 @@ def test_reservoir_top_renders_raw_snapshot_file(tmp_path):
     write_json_snapshot(path, reg, include_blocks=False)
     frame = reservoir_top.render(reservoir_top.collect(path))
     assert "ingest admission" in frame and "NO HEARTBEAT" in frame
+
+
+# ------------------------------------------- reservoir_top degraded states
+
+
+def test_reservoir_top_absent_and_stale_heartbeat(tmp_path):
+    # absent heartbeat: the degraded banner, no crash, no latency table
+    ckdir = tmp_path / "ck"
+    ckdir.mkdir()
+    frame = reservoir_top.render(reservoir_top.collect(str(ckdir)))
+    assert "NO HEARTBEAT" in frame
+    # stale heartbeat: older than --stale-after renders the STALE marker
+    # (the FailoverController's crash/hang signal, made visible)
+    import time as _time
+
+    with open(ckdir / "heartbeat.json", "w") as fh:
+        json.dump({"ts": _time.time() - 120.0, "epoch": 0, "seq": 7}, fh)
+    frame = reservoir_top.render(
+        reservoir_top.collect(str(ckdir), stale_after=10.0)
+    )
+    assert "** STALE **" in frame and "seq=7" in frame
+    # a fresh beat at a generous stale_after renders clean
+    with open(ckdir / "heartbeat.json", "w") as fh:
+        json.dump({"ts": _time.time(), "epoch": 0, "seq": 8}, fh)
+    frame = reservoir_top.render(
+        reservoir_top.collect(str(ckdir), stale_after=10.0)
+    )
+    assert "STALE" not in frame and "fence: ok" in frame
+
+
+def test_reservoir_top_fenced_banner_survives_torn_standby_file(tmp_path):
+    # mid-rewrite standby status (a torn half-written JSON) must not mask
+    # the FENCED banner or crash the frame — the fence verdict comes from
+    # heartbeat vs persisted epoch alone
+    import time as _time
+
+    ckdir = tmp_path / "ck"
+    ckdir.mkdir()
+    with open(ckdir / "heartbeat.json", "w") as fh:
+        json.dump({"ts": _time.time(), "epoch": 0, "seq": 3}, fh)
+    with open(ckdir / "epoch.json", "w") as fh:
+        json.dump({"epoch": 2}, fh)  # a standby was promoted past the beat
+    torn = tmp_path / "standby.json"
+    torn.write_text('{"applied_seq": 3, "lag_')  # rewrite torn mid-flight
+    frame = reservoir_top.render(
+        reservoir_top.collect(str(ckdir), str(torn))
+    )
+    assert "** FENCED (persisted epoch 2) **" in frame
+    assert "standby" not in frame.lower().replace("standby.json", "")
+
+
+def test_reservoir_top_renders_slo_verdict_panel(tmp_path):
+    # the ISSUE-7 panel: verdicts from the embedded SLO export render one
+    # row per objective, with the PAGE banner when anything pages
+    from reservoir_tpu.obs import SLOPlane, SLOSpec, write_json_snapshot
+
+    reg = Registry()
+    specs = (
+        SLOSpec("ingest_latency_p99", "latency_quantile", "serve.ingest_s",
+                threshold=0.05),
+        SLOSpec("sample_quality", "sample_quality", "audit.ks_breaches",
+                total_instrument="audit.ks_checks", budget=0.05,
+                value_instrument="audit.ks_statistic"),
+    )
+    SLOPlane(specs, reg)
+    reg.histogram("serve.ingest_s").observe(0.001)
+    reg.counter("audit.ks_checks").inc(10)
+    reg.counter("audit.ks_breaches").inc(10)
+    reg.gauge("audit.ks_statistic").set(0.41)
+    path = str(tmp_path / "telemetry.json")
+    write_json_snapshot(path, reg, include_blocks=False)
+    frame = reservoir_top.render(reservoir_top.collect(path))
+    assert "** SLO PAGE: sample_quality **" in frame
+    assert "ingest_latency_p99" in frame and "ok" in frame
+    lines = [ln for ln in frame.splitlines() if "sample_quality" in ln]
+    assert any("page" in ln and "0.41" in ln for ln in lines)
+
+
+def test_heartbeat_embeds_slo_verdicts(tmp_path):
+    # the beat carries the SLO snapshot: reservoir_top's panel and the
+    # Prometheus scrape judge the SAME verdicts the heartbeat persisted
+    from reservoir_tpu.obs import SLOPlane
+
+    with obs.active() as reg:
+        plane = SLOPlane()
+        svc, standby, hb, ckdir = _ha_pair(tmp_path)
+        hb.beat()
+        with open(os.path.join(ckdir, "heartbeat.json")) as fh:
+            payload = json.load(fh)
+        slo = payload["telemetry"]["slo"]
+        assert slo["worst"] in ("ok", "warn", "page")
+        assert "ingest_latency_p99" in slo["verdicts"]
+        assert plane.last  # the embedded export evaluated this plane
+        frame = reservoir_top.render(reservoir_top.collect(ckdir))
+        assert "ingest_latency_p99" in frame
+        svc.shutdown()

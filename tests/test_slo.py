@@ -1,0 +1,557 @@
+"""SLO plane + sample-quality auditor + open-loop loadgen (ISSUE 7).
+
+The contract under test, in the order the ISSUE lists it:
+
+- declarative ``SLOSpec``s validate eagerly and judge rolling windows of
+  registry instruments with multi-window burn rates: a page needs BOTH
+  the short and the long window burning, so an old burst outside the
+  short window cannot page;
+- an injected latency fault (``utils/faults.py`` delay rule on
+  ``serve.ingest``) flips the latency objective ok -> page, an injected
+  failure rule flips the error-rate objective, and an injected
+  biased-sampler shim (a ``peek_arrays`` wrapper halving every sampled
+  position) flips ``sample_quality`` — statistical drift pages exactly
+  like a latency regression;
+- the ``SampleQualityAuditor`` passes an honest sampler and catches a
+  biased one (rolling pooled KS) and value-correlated bias (stratum
+  inclusion rates), with ZERO overhead while telemetry is disabled;
+- the verdicts ride every export surface (Prometheus, JSON snapshot,
+  heartbeat — pinned in test_obs.py for reservoir_top);
+- ``tools/loadgen.py`` draws deterministic open-loop schedules (Poisson
+  and bursty), drives a real service through churn/eviction pressure,
+  and records the coordinated-omission-corrected wait.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+from reservoir_tpu import SamplerConfig, obs
+from reservoir_tpu.errors import SessionIngestError, TransientDeviceError
+from reservoir_tpu.obs import (
+    Registry,
+    SampleQualityAuditor,
+    SLOPlane,
+    SLOSpec,
+    default_slos,
+    json_snapshot,
+    prometheus_text,
+)
+from reservoir_tpu.serve import ReservoirService
+from reservoir_tpu.utils import faults
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(_REPO, "tools"))
+import loadgen  # noqa: E402
+
+sys.path.pop(0)
+
+
+@pytest.fixture(autouse=True)
+def _telemetry_disabled():
+    obs.disable()
+    yield
+    obs.disable()
+
+
+class _FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def _cfg(R=8, B=16, k=4, **kw):
+    return SamplerConfig(
+        max_sample_size=k, num_reservoirs=R, tile_size=B, **kw
+    )
+
+
+# ------------------------------------------------------------------ SLOSpec
+
+
+class TestSLOSpec:
+    def test_validates_eagerly(self):
+        with pytest.raises(ValueError, match="kind"):
+            SLOSpec("x", "nope", "h")
+        with pytest.raises(ValueError, match="threshold"):
+            SLOSpec("x", "latency_quantile", "h", threshold=0.0)
+        with pytest.raises(ValueError, match="quantile"):
+            SLOSpec("x", "latency_quantile", "h", threshold=1.0, quantile=1.5)
+        with pytest.raises(ValueError, match="total_instrument"):
+            SLOSpec("x", "error_rate", "bad")
+        with pytest.raises(ValueError, match="budget"):
+            SLOSpec("x", "error_rate", "bad", total_instrument="t", budget=2.0)
+        with pytest.raises(ValueError, match="short_window"):
+            SLOSpec(
+                "x", "staleness", "h", threshold=1.0,
+                short_window_s=100.0, long_window_s=10.0,
+            )
+
+    def test_error_budget_and_objective_line(self):
+        lat = SLOSpec(
+            "lat", "latency_quantile", "serve.ingest_s",
+            threshold=0.05, quantile=0.99,
+        )
+        assert lat.error_budget() == pytest.approx(0.01)
+        assert "p99" in lat.objective() and "50ms" in lat.objective()
+        err = SLOSpec(
+            "err", "error_rate", "bad", total_instrument="total", budget=0.02
+        )
+        assert err.error_budget() == 0.02
+
+    def test_default_slos_are_valid_and_unique(self):
+        specs = default_slos()
+        names = [s.name for s in specs]
+        assert len(set(names)) == len(names)
+        assert {"ingest_latency_p99", "sample_quality"} <= set(names)
+        SLOPlane(specs)  # constructs (duplicate-name check passes)
+
+
+# ---------------------------------------------------------------- burn rates
+
+
+class TestBurnRates:
+    def _plane(self, spec, clock):
+        reg = Registry()
+        return reg, SLOPlane([spec], reg, clock=clock)
+
+    def test_latency_objective_ok_then_page(self):
+        clock = _FakeClock()
+        spec = SLOSpec(
+            "lat", "latency_quantile", "h", threshold=0.01, quantile=0.99,
+            short_window_s=60, long_window_s=600,
+        )
+        reg, plane = self._plane(spec, clock)
+        h = reg.histogram("h")
+        for _ in range(100):
+            h.observe(0.001)  # all good
+        clock.t += 10
+        v = plane.evaluate()["lat"]
+        assert v.verdict == "ok" and v.burn_short == 0.0
+        # half the requests breach a 1% budget: burn 50x, page territory
+        for _ in range(100):
+            h.observe(1.0)
+        clock.t += 10
+        v = plane.evaluate()["lat"]
+        assert v.verdict == "page"
+        assert v.burn_short >= spec.page_burn
+        assert v.value > 0.01  # the live p90 rides the verdict
+
+    def test_old_burst_outside_short_window_does_not_page(self):
+        clock = _FakeClock()
+        spec = SLOSpec(
+            "lat", "latency_quantile", "h", threshold=0.01, quantile=0.99,
+            short_window_s=60, long_window_s=600,
+        )
+        reg, plane = self._plane(spec, clock)
+        h = reg.histogram("h")
+        for _ in range(50):
+            h.observe(1.0)  # the burst: every request bad
+        clock.t += 5
+        assert plane.evaluate()["lat"].verdict == "page"  # burst is live
+        # clean traffic for well past the short window
+        for step in range(8):
+            clock.t += 30
+            for _ in range(150):
+                h.observe(0.001)
+            plane.evaluate()
+        v = plane.evaluate()["lat"]
+        # long window still remembers the burst, short window is clean —
+        # multi-window AND: no page, no warn
+        assert v.verdict == "ok"
+        assert v.burn_short < spec.warn_burn <= v.burn_long
+
+    def test_error_rate_objective(self):
+        clock = _FakeClock()
+        spec = SLOSpec(
+            "err", "error_rate", "bad", total_instrument="total",
+            budget=0.01, short_window_s=60, long_window_s=600,
+        )
+        reg, plane = self._plane(spec, clock)
+        reg.counter("total").inc(1000)
+        clock.t += 1
+        assert plane.evaluate()["err"].verdict == "ok"
+        reg.counter("bad").inc(500)
+        reg.counter("total").inc(500)
+        clock.t += 1
+        v = plane.evaluate()["err"]
+        assert v.verdict == "page"
+        assert v.value == pytest.approx(500 / 1500)  # bad/total delta
+
+    def test_no_traffic_is_ok_not_page(self):
+        clock = _FakeClock()
+        spec = SLOSpec(
+            "err", "error_rate", "bad", total_instrument="total", budget=0.01
+        )
+        reg, plane = self._plane(spec, clock)
+        clock.t += 100
+        v = plane.evaluate()["err"]
+        assert v.verdict == "ok" and v.total == 0
+
+    def test_plane_attaches_to_registry_for_exporters(self):
+        reg = Registry()
+        plane = SLOPlane(default_slos(), reg)
+        assert reg.slo_plane is plane
+
+
+# ----------------------------------------------------- injected-fault flips
+
+
+def _drive(svc, n=30, chunk=32):
+    svc.open_session("u1")
+    pos = 0
+    for _ in range(n):
+        svc.ingest("u1", np.arange(pos, pos + chunk, dtype=np.int32))
+        pos += chunk
+
+
+def test_injected_latency_fault_flips_latency_slo_to_page():
+    # the ISSUE-7 acceptance: a delay-only fault rule on serve.ingest
+    # (utils/faults.py) must flip the latency objective ok -> page
+    spec = SLOSpec(
+        "ingest_latency_p99", "latency_quantile", "serve.ingest_s",
+        threshold=0.005, quantile=0.99,
+    )
+    with obs.active() as reg:
+        plane = SLOPlane([spec], reg)
+        _drive(ReservoirService(_cfg(), coalesce_bytes=1 << 20))
+        assert plane.evaluate()["ingest_latency_p99"].verdict == "ok"
+    obs.disable()
+    plane_f = None
+    rule = faults.FaultRule("serve.ingest", exc=None, delay=0.02)
+    with obs.active() as reg:
+        plane_f = SLOPlane([spec], reg)
+        svc = ReservoirService(
+            _cfg(), coalesce_bytes=1 << 20,
+            faults=faults.FaultPlane([rule]),
+        )
+        _drive(svc, n=10)
+        v = plane_f.evaluate()["ingest_latency_p99"]
+        assert v.verdict == "page"
+        assert v.value > 0.005
+
+
+def test_injected_failure_fault_flips_error_rate_slo_to_page():
+    spec = SLOSpec(
+        "ingest_error_rate", "error_rate", "serve.ingest_errors",
+        total_instrument="serve.ingest_total", budget=0.01,
+    )
+    rule = faults.FaultRule(
+        "serve.ingest", exc=TransientDeviceError, after=2, every=2
+    )
+    with obs.active() as reg:
+        plane = SLOPlane([spec], reg)
+        svc = ReservoirService(
+            _cfg(), coalesce_bytes=1 << 20, faults=faults.FaultPlane([rule])
+        )
+        svc.open_session("u1")
+        failures = 0
+        for i in range(20):
+            try:
+                svc.ingest("u1", np.arange(16, dtype=np.int32))
+            except SessionIngestError:
+                failures += 1
+        assert failures > 0  # the service survived every one of them
+        v = plane.evaluate()["ingest_error_rate"]
+        assert v.verdict == "page"
+        assert v.total == 20 and v.bad == failures
+
+
+def test_biased_sampler_shim_flips_sample_quality_slo_to_page(monkeypatch):
+    # the ISSUE-7 acceptance: a biased-sampler shim — every sampled
+    # position halved, so snapshots only ever show the low half of the
+    # stream — must page sample_quality while an honest run stays ok
+    from reservoir_tpu.engine import ReservoirEngine
+
+    spec = SLOSpec(
+        "sample_quality", "sample_quality", "audit.ks_breaches",
+        total_instrument="audit.ks_checks", budget=0.05,
+        value_instrument="audit.ks_statistic",
+    )
+
+    def run(shimmed):
+        auditor = SampleQualityAuditor(min_pool=64)
+        with obs.active() as reg:
+            plane = SLOPlane([spec], reg)
+            svc = ReservoirService(
+                _cfg(R=8, B=16, k=8), auditor=auditor, coalesce_bytes=256
+            )
+            if shimmed:
+                orig = ReservoirEngine.peek_arrays
+
+                def biased(self):
+                    samples, sizes = orig(self)
+                    return samples // 2, sizes  # low-half bias
+
+                monkeypatch.setattr(ReservoirEngine, "peek_arrays", biased)
+            svc.open_session("u1")
+            pos = 0
+            for _ in range(12):
+                svc.ingest("u1", np.arange(pos, pos + 64, dtype=np.int32))
+                pos += 64
+                svc.snapshot("u1")  # sync read: the audited path
+            verdict = plane.evaluate()["sample_quality"]
+            checks = reg.counter("audit.ks_checks").value
+            if shimmed:
+                monkeypatch.setattr(ReservoirEngine, "peek_arrays", orig)
+        return verdict, checks
+
+    honest, checks = run(shimmed=False)
+    assert checks >= 1
+    assert honest.verdict == "ok"
+    paged, checks = run(shimmed=True)
+    assert checks >= 1
+    assert paged.verdict == "page"
+    assert paged.value > 0.2  # the live KS distance rides the verdict
+
+
+# ------------------------------------------------------------------ auditor
+
+
+class TestAuditor:
+    def test_honest_uniform_sampler_passes(self):
+        rng = np.random.default_rng(3)
+        aud = SampleQualityAuditor(min_pool=256)
+        with obs.active() as reg:
+            for _ in range(40):
+                n = 5000
+                aud.observe_snapshot("s", rng.integers(0, n, 16), n)
+            assert reg.counter("audit.ks_checks").value >= 2
+            assert reg.counter("audit.ks_breaches").value == 0
+
+    def test_low_half_bias_breaches(self):
+        rng = np.random.default_rng(4)
+        aud = SampleQualityAuditor(min_pool=256)
+        with obs.active() as reg:
+            for _ in range(40):
+                n = 5000
+                aud.observe_snapshot("s", rng.integers(0, n // 2, 16), n)
+            assert reg.counter("audit.ks_breaches").value >= 1
+            assert aud.last_ks > 0.3
+
+    def test_opaque_values_do_not_feed_ks_pool(self):
+        aud = SampleQualityAuditor(min_pool=64)
+        with obs.active() as reg:
+            for _ in range(20):
+                # values far outside [0, n): opaque production payloads
+                aud.observe_snapshot(
+                    "s", np.full(16, 10_000_000, np.int64), 100
+                )
+            assert reg.peek("audit.ks_statistic") is None
+            assert reg.counter("audit.ks_checks").value == 0
+
+    def test_stratum_bias_detected(self):
+        aud = SampleQualityAuditor(
+            min_pool=512, strata=4, min_stratum_count=256, stratum_gate=0.5
+        )
+        rng = np.random.default_rng(5)
+        with obs.active() as reg:
+            n = 4096
+            for _ in range(40):
+                aud.record_ingest("s", rng.integers(0, n, 128))
+                # the "sampler" only ever returns even values: strata 1/3
+                # (odd residues) are never included -> rate deviation 1.0
+                aud.observe_snapshot("s", rng.integers(0, n // 2, 16) * 2, n)
+            assert reg.counter("audit.stratum_checks").value >= 1
+            assert reg.counter("audit.stratum_breaches").value >= 1
+            assert aud.last_stratum_dev > 0.5
+
+    def test_noop_and_stateless_when_disabled(self):
+        aud = SampleQualityAuditor(min_pool=8)
+        aud.record_ingest("s", np.arange(100))
+        aud.observe_snapshot("s", np.arange(16), 100)
+        assert aud.last_ks is None
+        assert aud._pool_n == 0 and int(aud._ingested.sum()) == 0
+
+
+# ------------------------------------------------------------------ exports
+
+
+def test_verdicts_ride_prometheus_and_json_exports():
+    reg = Registry()
+    spec = SLOSpec(
+        "err", "error_rate", "bad", total_instrument="total", budget=0.01
+    )
+    SLOPlane([spec], reg)
+    reg.counter("bad").inc(50)
+    reg.counter("total").inc(50)
+    text = prometheus_text(reg, include_blocks=False)
+    assert '# TYPE reservoir_slo_verdict gauge' in text
+    assert 'reservoir_slo_verdict{slo="err"} 2' in text  # page encodes 2
+    assert 'reservoir_slo_burn_short{slo="err"}' in text
+    snap = json_snapshot(reg, include_blocks=False)
+    assert snap["slo"]["worst"] == "page"
+    assert snap["slo"]["verdicts"]["err"]["verdict"] == "page"
+
+
+def test_plane_without_registry_is_inert():
+    plane = SLOPlane()  # telemetry disabled: nothing to bind
+    assert plane.evaluate() == {}
+    assert plane.worst() == "ok"
+
+
+# ------------------------------------------------------------------ loadgen
+
+
+class TestLoadgen:
+    def test_schedule_is_deterministic_and_rate_shaped(self):
+        spec = loadgen.LoadSpec(duration_s=4.0, rate=500.0, sessions=64)
+        off1, idx1 = loadgen.build_schedule(spec)
+        off2, idx2 = loadgen.build_schedule(spec)
+        assert np.array_equal(off1, off2) and np.array_equal(idx1, idx2)
+        assert off1.size == pytest.approx(2000, rel=0.2)
+        assert np.all(np.diff(off1) >= 0) and off1.max() < 4.0
+        assert idx1.min() >= 0 and idx1.max() < 64
+
+    def test_bursty_schedule_same_mean_heavier_tail(self):
+        base = dict(duration_s=8.0, rate=400.0, sessions=8, seed=7)
+        pois, _ = loadgen.build_schedule(loadgen.LoadSpec(**base))
+        bur, _ = loadgen.build_schedule(
+            loadgen.LoadSpec(arrivals="bursty", **base)
+        )
+        assert bur.size == pytest.approx(pois.size, rel=0.25)  # same mean
+        # burstiness: the variance of per-100ms bin counts is far higher
+        bins = np.arange(0, 8.01, 0.1)
+        vp = np.histogram(pois, bins)[0].var()
+        vb = np.histogram(bur, bins)[0].var()
+        assert vb > 1.5 * vp
+
+    def test_bursty_validation(self):
+        with pytest.raises(ValueError, match="burst_factor"):
+            loadgen.LoadSpec(
+                arrivals="bursty", burst_factor=8.0, burst_duty=0.25
+            )
+        with pytest.raises(ValueError, match="poisson|bursty"):
+            loadgen.LoadSpec(arrivals="lumpy")
+
+    def test_run_load_open_loop_with_churn_and_eviction(self):
+        # key universe (32) over a 16-row table: eviction pressure forces
+        # reopens; churn closes sessions; every arrival is accounted for
+        svc = ReservoirService(_cfg(R=16, B=16, k=4), coalesce_bytes=1 << 14)
+        spec = loadgen.LoadSpec(
+            duration_s=0.2,
+            rate=2000.0,
+            sessions=32,
+            zipf_s=0.3,
+            chunk=16,
+            churn=0.05,
+            snapshot_every=17,
+            seed=2,
+        )
+        with obs.active() as reg:
+            res = loadgen.run_load(svc, spec)
+            assert res.offered > 100
+            assert res.completed + res.rejected + res.errors == res.offered
+            assert res.errors == 0
+            assert res.opens + res.reopens >= 32
+            assert res.reopens > 0  # eviction pressure was real
+            assert res.elements == res.completed * spec.chunk
+            wait = reg.histogram("loadgen.wait_s")
+            assert wait.count == res.offered  # every arrival recorded
+            assert res.wait_p99_s >= res.wait_p50_s >= 0.0
+
+    def test_corrected_wait_charges_lateness_to_the_service(self):
+        # a virtual clock where every ingest costs 50ms against a 1000/s
+        # schedule: the service is ~50x oversubscribed, so the corrected
+        # wait must grow with the backlog (the coordinated-omission story)
+        svc = ReservoirService(_cfg(R=8, B=16, k=4), coalesce_bytes=1 << 20)
+        vt = {"t": 0.0}
+
+        def clock():
+            return vt["t"]
+
+        def sleep(s):
+            vt["t"] += s
+
+        real_ingest = ReservoirService.ingest
+
+        def slow_ingest(self, key, elements, weights=None):
+            vt["t"] += 0.05
+            return real_ingest(self, key, elements, weights)
+
+        ReservoirService.ingest = slow_ingest
+        try:
+            spec = loadgen.LoadSpec(
+                duration_s=0.1, rate=1000.0, sessions=4, chunk=8, seed=3
+            )
+            with obs.active():
+                res = loadgen.run_load(svc, spec, clock=clock, sleep=sleep)
+        finally:
+            ReservoirService.ingest = real_ingest
+        assert res.offered >= 50
+        assert res.max_behind_s > 1.0  # the schedule ran far ahead
+        # the backlog grows linearly, so the tail wait dwarfs the median
+        assert res.wait_p99_s > 1.5 * res.wait_p50_s
+        assert res.wait_p999_s >= res.wait_p99_s >= res.wait_p50_s > 0.05
+
+
+def test_slo_page_degrades_health_without_promoting(tmp_path):
+    # the heartbeat carries slo_worst (ISSUE 7) and the controller treats
+    # a paging primary as DEGRADED, never as a promote trigger — failover
+    # cannot fix a burning latency budget or a biased sampler
+    import json
+
+    from reservoir_tpu.serve.ha import FailoverController
+
+    class _Standby:  # the controller only reads dir + metrics from it
+        checkpoint_dir = str(tmp_path)
+
+        from reservoir_tpu.utils.metrics import HAMetrics
+
+        metrics = HAMetrics()
+
+    clock = _FakeClock()
+    with open(os.path.join(str(tmp_path), "heartbeat.json"), "w") as fh:
+        json.dump({"ts": clock.t, "epoch": 0, "seq": 1,
+                   "slo_worst": "page"}, fh)
+    ctrl = FailoverController(_Standby(), clock=clock)
+    report = ctrl.health()
+    assert not report.healthy
+    assert not report.should_promote
+    assert any("SLO page" in r for r in report.reasons)
+
+
+def test_heartbeat_carries_slo_worst(tmp_path):
+    from reservoir_tpu.serve import HeartbeatWriter
+
+    spec = SLOSpec(
+        "err", "error_rate", "bad", total_instrument="total", budget=0.01
+    )
+    with obs.active() as reg:
+        SLOPlane([spec], reg)
+        reg.counter("bad").inc(10)
+        reg.counter("total").inc(10)
+        svc = ReservoirService(
+            _cfg(), checkpoint_dir=str(tmp_path), coalesce_bytes=1 << 20
+        )
+        payload = HeartbeatWriter(str(tmp_path), service=svc).beat()
+        assert payload["slo_worst"] == "page"
+        svc.shutdown()
+
+
+def test_service_recover_accepts_auditor(tmp_path):
+    # the auditor rides recovery like every other serving knob
+    svc = ReservoirService(
+        _cfg(), checkpoint_dir=str(tmp_path), coalesce_bytes=1 << 20
+    )
+    svc.open_session("u1")
+    svc.ingest("u1", np.arange(32, dtype=np.int32))
+    svc.sync()
+    svc.shutdown()
+    del svc
+    aud = SampleQualityAuditor(min_pool=8)
+    rec = ReservoirService.recover(str(tmp_path), auditor=aud)
+    assert rec._auditor is aud
+    with obs.active() as reg:
+        # a recovered session's element counter restarts with the lease,
+        # so the audit pool fills from post-recovery traffic
+        rec.ingest("u1", np.arange(32, dtype=np.int32))
+        for _ in range(3):
+            rec.snapshot("u1")
+        assert reg.counter("audit.ks_checks").value >= 1

@@ -397,6 +397,87 @@ def test_ha_rehearsal_post_step_registered():
     assert "ha" in tpu_watch.CONFIG_BUDGETS
 
 
+def test_parity_probe_post_step_registered(tmp_path, monkeypatch):
+    # the ISSUE-7 satellite (ROADMAP item 3 tail): a budget-capped
+    # on-device selftest runs FIRST in the post-step queue — parity
+    # evidence must never be starved by a long sweep — and its JSON
+    # (pallas_parity / ks gates) lands STRUCTURED on the capture record,
+    # not buried in an output tail
+    steps = {name: (cmd, timeout, env) for name, cmd, timeout, env in
+             tpu_watch.POST_STEPS}
+    cmd, timeout, env = steps["parity_probe"]
+    assert cmd[-2:] == ["-m", "reservoir_tpu.utils.selftest"]
+    assert 0 < timeout <= 900
+    assert [name for name, *_ in tpu_watch.POST_STEPS][0] == "parity_probe"
+
+    # drive _run_post_step against a simulated selftest child: the JSON
+    # line is parsed onto the record as `result`
+    monkeypatch.setattr(tpu_watch, "REPO", str(tmp_path))
+    monkeypatch.setattr(
+        tpu_watch, "CAPTURE", str(tmp_path / "TPU_CAPTURE_r94.jsonl")
+    )
+
+    class _Proc:
+        returncode = 0
+        stderr = ""
+        stdout = json.dumps(
+            {"platform": "tpu", "pallas_parity": True, "ks_ok": True,
+             "ks_uniform": 0.004}
+        ) + "\n"
+
+    monkeypatch.setattr(tpu_watch.subprocess, "run", lambda *a, **k: _Proc())
+    assert tpu_watch._run_post_step("parity_probe", cmd, timeout, env)
+    rows = [
+        json.loads(line)
+        for line in open(tmp_path / "TPU_CAPTURE_r94.jsonl")
+    ]
+    assert rows[-1]["result"]["pallas_parity"] is True
+    assert rows[-1]["result"]["ks_ok"] is True
+
+
+def test_traffic_config_registered():
+    # the ISSUE-7 traffic harness rides the capture queue, budget-capped
+    # like every other config, with the parity selftest off (host-path
+    # row; parity rides the algl/distinct/weighted rows)
+    assert "traffic" in tpu_watch.DEFAULT_CONFIGS.split(",")
+    timeout_s, env = tpu_watch.CONFIG_BUDGETS["traffic"]
+    assert 0 < timeout_s <= 900
+    assert env.get("RESERVOIR_BENCH_SELFTEST") == "0"
+
+
+def test_capture_surfaces_slo_verdicts(tmp_path, monkeypatch):
+    # a traffic evidence row carrying SLO verdicts must lift them to the
+    # capture row's top level, like geometry/fault_counters/telemetry
+    monkeypatch.setattr(tpu_watch, "REPO", str(tmp_path))
+    monkeypatch.setattr(
+        tpu_watch, "CAPTURE", str(tmp_path / "TPU_CAPTURE_r93.jsonl")
+    )
+
+    class _Proc:
+        returncode = 0
+        stderr = ""
+        stdout = json.dumps(
+            {
+                "metric": "traffic_loadgen_elements_per_sec",
+                "value": 1e6,
+                "platform": "cpu",
+                "slo": {"ingest_latency_p99": "ok", "sample_quality": "page"},
+                "stages": {"telemetry": {"loadgen.wait_s": {"count": 5}}},
+            }
+        ) + "\n"
+
+    monkeypatch.setattr(tpu_watch.subprocess, "run", lambda *a, **k: _Proc())
+    assert tpu_watch.capture_bench("traffic") == "ok"
+    rows = [
+        json.loads(line)
+        for line in open(tmp_path / "TPU_CAPTURE_r93.jsonl")
+    ]
+    assert rows[-1]["slo"] == {
+        "ingest_latency_p99": "ok", "sample_quality": "page",
+    }
+    assert rows[-1]["telemetry"]["loadgen.wait_s"]["count"] == 5
+
+
 def test_capture_surfaces_fault_counters(tmp_path, monkeypatch):
     # a bridge evidence row carrying robustness counters must lift them to
     # the capture row's top level, like the tuned geometry
@@ -466,20 +547,21 @@ def test_post_step_rehearsal_sequential_gating(tmp_path, monkeypatch):
 
     monkeypatch.setattr(tpu_watch.subprocess, "run", fake_run)
     remaining = tpu_watch.run_post_steps(list(tpu_watch.POST_STEPS))
-    # algl + weighted sweeps ran and were committed; distinct failed and
-    # carries over together with everything gated behind it
+    # parity probe + algl + weighted sweeps ran and were committed;
+    # distinct failed and carries over with everything gated behind it
     assert any("--kernel weighted" in r for r in ran)
     assert [s[0] for s in remaining] == [
         "distinct_sweep", "pallas_device_tests", "algl_best_block",
         "serve_soak", "ha_rehearsal", "recovery_rehearsal",
     ]
-    assert committed == ["2 post-step(s) recorded"]
+    assert committed == ["3 post-step(s) recorded"]
     rows = [
         json.loads(line)
         for line in open(tmp_path / "TPU_CAPTURE_r96.jsonl")
     ]
     assert [r["post_step"] for r in rows] == [
-        "algl_block_sweep", "weighted_sweep", "distinct_sweep"
+        "parity_probe", "algl_block_sweep", "weighted_sweep",
+        "distinct_sweep",
     ]
 
 

@@ -20,12 +20,22 @@ process):
 Usage::
 
     python tools/reservoir_top.py /path/to/checkpoint_dir \
-        [--standby /path/to/standby.json] [--interval 1.0] [--once] [--plain]
+        [--standby /path/to/standby.json] [--interval 1.0] [--once] \
+        [--plain] [--stale-after 10.0]
 
 ``--once`` prints a single plain-text frame and exits (what the tests
 drive); the default is a curses loop falling back to a plain-text loop
 when no TTY/curses is available.  Flush/ingest rates are derived from
 successive frames (counter deltas over wall time).
+
+Degraded states render explicitly (ISSUE 7 satellite): a missing
+heartbeat is ``NO HEARTBEAT``, one older than ``--stale-after`` gains a
+``** STALE **`` marker, a persisted epoch ahead of the beat renders the
+``** FENCED **`` banner (even while the standby status file is mid-
+rewrite — a torn read is simply skipped), and when the embedded
+telemetry carries SLO verdicts (``obs/slo.py``) an SLO panel renders one
+row per objective with burn rates, plus an ``** SLO PAGE **`` banner
+when any objective pages.
 """
 
 from __future__ import annotations
@@ -61,10 +71,18 @@ def _read_json(path: str) -> Optional[dict]:
         return None
 
 
-def collect(target: str, standby_path: Optional[str] = None) -> dict:
+def collect(
+    target: str,
+    standby_path: Optional[str] = None,
+    stale_after: float = 10.0,
+) -> dict:
     """Gather one status sample from the on-disk surfaces.  ``target`` is
-    a checkpoint directory (heartbeat/epoch) or a telemetry JSON file."""
-    status: dict = {"ts": time.time(), "target": target}
+    a checkpoint directory (heartbeat/epoch) or a telemetry JSON file.
+    ``stale_after`` is the heartbeat age (seconds) past which the primary
+    line renders a ``** STALE **`` marker."""
+    status: dict = {
+        "ts": time.time(), "target": target, "stale_after": stale_after,
+    }
     if os.path.isdir(target):
         status["heartbeat"] = _read_json(
             os.path.join(target, "heartbeat.json")
@@ -97,6 +115,10 @@ def _fence_line(status: dict) -> str:
         f"primary: seq={hb.get('seq', '?')} epoch={epoch} "
         f"beat {age:.1f}s ago"
     )
+    if age > float(status.get("stale_after", 10.0)):
+        # a beating-but-old heartbeat is the crash/hang signal the
+        # FailoverController promotes on — say so before the fence state
+        line += "  ** STALE **"
     if persisted is not None and persisted > epoch:
         line += f"  ** FENCED (persisted epoch {persisted}) **"
     else:
@@ -119,6 +141,40 @@ def _rate_lines(status: dict, prev: Optional[dict]) -> list:
 
 def _fmt_ms(v: float) -> str:
     return f"{v * 1e3:9.3f}ms"
+
+
+def _slo_lines(tel: Optional[dict]) -> list:
+    """The verdict panel (ISSUE 7): one row per objective from the
+    embedded SLO export, plus a banner when anything pages."""
+    slo = (tel or {}).get("slo") or {}
+    verdicts = slo.get("verdicts") or {}
+    if not verdicts:
+        return []
+    lines = [""]
+    paging = sorted(
+        name for name, v in verdicts.items() if v.get("verdict") == "page"
+    )
+    if paging:
+        lines.append(f"** SLO PAGE: {', '.join(paging)} **")
+    lines.append(
+        f"{'slo':<24}{'verdict':>8}{'burn 5m':>10}{'burn 1h':>10}"
+        f"{'value':>12}  objective"
+    )
+    for name in sorted(verdicts):
+        v = verdicts[name]
+        value = float(v.get("value", 0.0))
+        shown = (
+            _fmt_ms(value).strip()
+            if v.get("kind") in ("latency_quantile", "staleness")
+            else f"{value:.4g}"
+        )
+        lines.append(
+            f"{name:<24}{v.get('verdict', '?'):>8}"
+            f"{float(v.get('burn_short', 0.0)):>10.2f}"
+            f"{float(v.get('burn_long', 0.0)):>10.2f}"
+            f"{shown:>12}  {v.get('objective', '')}"
+        )
+    return lines
 
 
 def render(status: dict, prev: Optional[dict] = None) -> str:
@@ -150,6 +206,7 @@ def render(status: dict, prev: Optional[dict] = None) -> str:
             f"errors={int(sb.get('ship_errors', 0)) + int(sb.get('apply_errors', 0))}"
         )
     tel = status.get("telemetry")
+    lines.extend(_slo_lines(tel))
     if tel:
         hists = tel.get("histograms", {})
         rows = [
@@ -204,7 +261,7 @@ def _loop_plain(args) -> int:
     prev = None
     try:
         while True:
-            status = collect(args.target, args.standby)
+            status = collect(args.target, args.standby, args.stale_after)
             frame = render(status, prev)
             print("\x1b[2J\x1b[H" + frame, flush=True)
             prev = status
@@ -221,7 +278,7 @@ def _loop_curses(args) -> int:
         stdscr.nodelay(True)
         prev = None
         while True:
-            status = collect(args.target, args.standby)
+            status = collect(args.target, args.standby, args.stale_after)
             frame = render(status, prev)
             stdscr.erase()
             maxy, maxx = stdscr.getmaxyx()
@@ -251,6 +308,12 @@ def main(argv=None) -> int:
     )
     ap.add_argument("--interval", type=float, default=1.0)
     ap.add_argument(
+        "--stale-after",
+        type=float,
+        default=10.0,
+        help="heartbeat age (s) past which the primary renders ** STALE **",
+    )
+    ap.add_argument(
         "--once", action="store_true", help="print one frame and exit"
     )
     ap.add_argument(
@@ -260,7 +323,7 @@ def main(argv=None) -> int:
     )
     args = ap.parse_args(argv)
     if args.once:
-        print(render(collect(args.target, args.standby)))
+        print(render(collect(args.target, args.standby, args.stale_after)))
         return 0
     if not args.plain and sys.stdout.isatty():
         try:

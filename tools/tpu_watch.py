@@ -81,6 +81,10 @@ CONFIG_BUDGETS: dict[str, tuple[float, dict[str, str]]] = {
     # the ISSUE-5 HA plane: failover-time-ms + replication lag with a hot
     # standby tailing the journal; host-path config, no parity selftest
     "ha": (420.0, {"RESERVOIR_BENCH_SELFTEST": "0"}),
+    # the ISSUE-7 traffic harness: open-loop loadgen over a >= 10k session
+    # universe, row carries corrected-wait quantiles + SLO verdicts + the
+    # online sample-quality audit; host-path config, no parity selftest
+    "traffic": (600.0, {"RESERVOIR_BENCH_SELFTEST": "0"}),
 }
 
 # r5 priority order (VERDICT r4): parity-attached headline first, then
@@ -90,7 +94,7 @@ CONFIG_BUDGETS: dict[str, tuple[float, dict[str, str]]] = {
 # a CONFIG_BUDGETS row (an unbudgeted config can burn a whole window).
 DEFAULT_CONFIGS = (
     "algl,algl_chunk1024,algl_chunk0,distinct,weighted,stream,bridge,"
-    "bridge_serial,serve,ha,algl_B4096"
+    "bridge_serial,serve,ha,traffic,algl_B4096"
 )
 
 def _now() -> str:
@@ -243,6 +247,11 @@ def capture_bench(
         # registry-sourced latency quantiles at the row's top level, like
         # geometry and fault_counters before them
         rec["telemetry"] = parsed["stages"]["telemetry"]
+    if isinstance(parsed, dict) and isinstance(parsed.get("slo"), dict):
+        # SLO verdicts (ISSUE 7): a traffic row's ok/warn/page map rides
+        # the capture row's top level — a captured row IS an SLO
+        # evaluation, so the verdicts must be greppable without digging
+        rec["slo"] = parsed["slo"]
     _append(rec)
     if proc.returncode != 0 or parsed is None:
         if "backend unreachable" in proc.stderr:
@@ -296,8 +305,12 @@ def _commit_capture(context: str) -> None:
 
 def _run_post_step(name: str, cmd: list[str], timeout_s: float, env=None) -> bool:
     """Run one post-capture step (block sweep / device tests) in a child
-    with a hard timeout, appending the outcome to the capture file."""
+    with a hard timeout, appending the outcome to the capture file.  A
+    step that prints a JSON line (the ``parity_probe`` selftest does)
+    gets it parsed onto the record as ``result`` — structured evidence,
+    not just an output tail."""
     t0 = time.time()
+    stdout = ""
     try:
         proc = subprocess.run(
             cmd,
@@ -308,22 +321,33 @@ def _run_post_step(name: str, cmd: list[str], timeout_s: float, env=None) -> boo
             env=dict(os.environ, **(env or {})),
         )
         rc: int | str = proc.returncode
+        stdout = proc.stdout
         tail = (proc.stdout + "\n" + proc.stderr)[-3000:]
     except subprocess.TimeoutExpired as e:
         rc = "timeout"
         out = e.stdout or b""
         if isinstance(out, bytes):
             out = out.decode(errors="replace")
+        stdout = out
         tail = out[-3000:]
-    _append(
-        {
-            "ts": _now(),
-            "post_step": name,
-            "rc": rc,
-            "wall_s": round(time.time() - t0, 1),
-            "output_tail": tail,
-        }
-    )
+    parsed = None
+    for line in stdout.splitlines():
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                parsed = json.loads(line)
+            except json.JSONDecodeError:
+                pass
+    rec = {
+        "ts": _now(),
+        "post_step": name,
+        "rc": rc,
+        "wall_s": round(time.time() - t0, 1),
+        "output_tail": tail,
+    }
+    if isinstance(parsed, dict):
+        rec["result"] = parsed
+    _append(rec)
     print(f"[{_now()}] post-step {name}: rc={rc}", flush=True)
     return rc == 0
 
@@ -335,6 +359,18 @@ def _run_post_step(name: str, cmd: list[str], timeout_s: float, env=None) -> boo
 # with a hard timeout — budget-capped like the bench configs — so a
 # tunnel drop or Mosaic compile blowup is recorded, not inherited.
 POST_STEPS: list[tuple[str, list[str], float, dict]] = [
+    (
+        # the ISSUE-7 satellite closing ROADMAP item 3's tail: a
+        # budget-capped on-device selftest whose JSON (pallas_parity +
+        # the three ks gates) lands structured on the capture row — the
+        # next TPU window pins `pallas_parity: true` / `ks_ok` instead
+        # of the r04 nulls.  FIRST in the queue: parity evidence must
+        # not be starved by a long sweep in a short window.
+        "parity_probe",
+        [sys.executable, "-m", "reservoir_tpu.utils.selftest"],
+        600.0,
+        {},
+    ),
     (
         "algl_block_sweep",
         [sys.executable, os.path.join(REPO, "tools", "tpu_block_sweep.py")],
