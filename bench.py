@@ -28,7 +28,7 @@ Env knobs:
   RESERVOIR_BENCH_SMOKE=1       tiny shapes for a CPU smoke run
   RESERVOIR_BENCH_CONFIG        algl (default) | distinct | weighted |
                                 bridge | stream | host | transfer | serve |
-                                ha | traffic
+                                ha | traffic | gated
                                 (bridge = incremental host-feed: interleaved
                                 demux -> staging -> per-flush dispatches,
                                 double-buffered; stream = fused host-feed:
@@ -51,7 +51,12 @@ Env knobs:
                                 >= 10k session universe with churn, row
                                 carries coordinated-omission-corrected
                                 wait + SLO burn-rate verdicts + the
-                                online sample-quality audit)
+                                online sample-quality audit; gated = the
+                                ingest-side skip-ahead gate A/B (ISSUE 8):
+                                the same feed through an ungated and a
+                                gated bridge, bit-identity asserted, row
+                                carries effective elem/s + speedup +
+                                skip_frac + bytes-shipped-per-element)
   RESERVOIR_BENCH_BLOCK_R       Pallas row-block override for the active
                                 config's kernel (algl default 64, others
                                 auto; 0 = auto)
@@ -366,6 +371,104 @@ def _bench_bridge(S, k, B, steps, reps):
         "checkpoints": m.checkpoints,
     }
     return times, stages
+
+
+def _bench_gated(S, k, B, steps, reps):
+    """Ingest-side skip-ahead gating A/B (ISSUE 8, ROADMAP item 3): the
+    SAME per-row feed through an ungated and a gated
+    ``DeviceStreamBridge`` — results are bit-identical by construction
+    (asserted per run), so the row is a pure effective-throughput A/B.
+    "Effective elem/s" counts every LOGICAL element consumed; the gated
+    bridge ships only candidate bytes (fill prefixes + acceptances), so
+    past the fill phase hundreds of acceptance-free flushes collapse into
+    one tiny ``[S, gate_tile]`` dispatch and effective throughput
+    decouples from the wire.  The non-smoke shape pins n/k >= 10^4 per
+    row, the regime the ISSUE-8 acceptance targets.
+
+    Env knobs: RESERVOIR_BENCH_GATE_CAP (gate-tile width, default 64)."""
+    from reservoir_tpu import SamplerConfig
+    from reservoir_tpu.stream.bridge import DeviceStreamBridge
+
+    cap = int(os.environ.get("RESERVOIR_BENCH_GATE_CAP", 64))
+    cfg = SamplerConfig(max_sample_size=k, num_reservoirs=S, tile_size=B)
+    rng = np.random.default_rng(0)
+    # one row-major synthetic stream, consumed by both sides at its best
+    # feed: the UNGATED bridge gets a pre-interleaved layout (rows fill in
+    # lockstep -> one [S, B] dispatch per step, its fastest mode, with the
+    # interleave transpose paid OUTSIDE the timed region); the GATED
+    # bridge gets per-row bulk pushes — the pre-staging fast path, where
+    # elided elements are never demuxed at all.  Same per-row streams,
+    # so the final reservoirs must be bit-identical (asserted below).
+    data = (
+        rng.integers(0, 1 << 30, (S, B * steps), dtype=np.int64)
+        .astype(np.int32)
+    )
+    streams = np.tile(np.arange(S, dtype=np.int32), B)
+    chunks = [
+        np.ascontiguousarray(data[:, t * B : (t + 1) * B].T.ravel())
+        for t in range(steps)
+    ]
+
+    def run(gated):
+        bridge = DeviceStreamBridge(
+            cfg, key=0, reusable=True, gated=gated, gate_tile=cap
+        )
+
+        def one_pass():
+            if gated:
+                for s in range(S):
+                    bridge.push(s, data[s])
+            else:
+                for chunk in chunks:
+                    bridge.push_interleaved(streams, chunk)
+            bridge.flush()
+            bridge.drain_barrier()
+            _readback_barrier(bridge._engine._state.count)
+
+        one_pass()  # warm: compiles fill + steady + gate eval/apply
+        m = bridge.metrics
+        m.demux_s = m.drain_s = m.dispatch_s = m.gate_eval_s = 0.0
+        m.elements = m.flushed_elements = m.flushes = 0
+        m.gated_dispatches = m.gate_buffered_flushes = 0
+        m.gate_bytes_shipped = m.gate_bytes_elided = 0
+        times = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            one_pass()
+            times.append(time.perf_counter() - t0)
+        return times, bridge
+
+    times_u, bridge_u = run(False)
+    times_g, bridge_g = run(True)
+    # bit-reconciliation is the row's license to exist: the A/B only
+    # counts if the gated path produced the identical reservoirs
+    su, zu = bridge_u.engine.peek_arrays()
+    sg, zg = bridge_g.engine.peek_arrays()
+    if not (np.array_equal(su, sg) and np.array_equal(zu, zg)):
+        raise RuntimeError("gated bridge diverged from the ungated path")
+    n = S * B * steps
+    mg = bridge_g.metrics.snapshot()
+    stages = {
+        "gate_tile": cap,
+        "n_over_k": (B * steps) // k,
+        "ungated_elem_per_s": n / min(times_u),
+        "gated_elem_per_s": n / min(times_g),
+        "speedup": min(times_u) / min(times_g),
+        "skip_frac": mg["gate_skip_frac"],
+        # bytes actually shipped per logical element (timed reps), vs the
+        # element's own width — the bytes-elided roofline (BENCH.md)
+        "bytes_per_elem_shipped": round(
+            mg["gate_bytes_shipped"] / max(1, mg["flushed_elements"]), 6
+        ),
+        "bytes_per_elem_raw": float(np.dtype(cfg.element_dtype).itemsize),
+        "gated_dispatches": mg["gated_dispatches"],
+        "gate_buffered_flushes": mg["gate_buffered_flushes"],
+        "gate_eval_s": round(mg["gate_eval_s"], 6),
+        "flushes_gated": mg["flushes"],
+        "flushes_ungated": bridge_u.metrics.snapshot()["flushes"],
+        "bit_identical": True,
+    }
+    return times_g, stages
 
 
 def _bench_serve(S, k, B, steps, reps):
@@ -839,11 +942,11 @@ def main() -> None:
     impl = os.environ.get("RESERVOIR_BENCH_IMPL", "auto")
     if config not in (
         "algl", "distinct", "weighted", "bridge", "stream", "host",
-        "transfer", "serve", "ha", "traffic",
+        "transfer", "serve", "ha", "traffic", "gated",
     ):
         raise SystemExit(
             "RESERVOIR_BENCH_CONFIG must be algl|distinct|weighted|bridge|"
-            f"stream|host|transfer|serve|ha|traffic, got {config!r}"
+            f"stream|host|transfer|serve|ha|traffic|gated, got {config!r}"
         )
     if impl not in ("auto", "xla", "pallas"):
         raise SystemExit(
@@ -879,6 +982,11 @@ def main() -> None:
             # overcommits it (>= 10k simulated sessions non-smoke) and
             # the row is judged on corrected wait + SLO verdicts
             "traffic": (192 if smoke else 8192, 8, 32 if smoke else 64),
+            # gated: the skip-ahead A/B (ISSUE 8).  Non-smoke pins
+            # n/k = B*steps/k >= 10^4 per row — the vanishing-acceptance
+            # regime where gating is the effective-throughput lever
+            "gated": (16 if smoke else 64, 8 if smoke else 16,
+                      256 if smoke else 4096),
         }[cfg]
         default_steps = {
             "bridge": 2 if smoke else 4,
@@ -889,6 +997,7 @@ def main() -> None:
             "ha": 2 if smoke else 4,
             # traffic: steps scales arrivals (steps * universe)
             "traffic": 2,
+            "gated": 4 if smoke else 40,
         }.get(cfg, 5 if smoke else 50)
         if not use_env:
             return (defaults[0], defaults[1], defaults[2], default_steps)
@@ -1093,6 +1202,9 @@ def main() -> None:
         elif config == "traffic":
             times, traffic_stages = _bench_traffic(R, k, B, steps, reps)
             tag = "traffic_loadgen"
+        elif config == "gated":
+            times, gated_stages = _bench_gated(R, k, B, steps, reps)
+            tag = "gated_bridge_feed"
         else:
             times, bridge_stages = _bench_bridge(R, k, B, steps, reps)
             tag = "bridge_host_feed"
@@ -1127,6 +1239,16 @@ def main() -> None:
         record["failover_ms"] = ha_stages["failover_ms_best"]
         record["lag_seq"] = ha_stages["lag_seq_max"]
         record["lag_s"] = ha_stages["lag_s_p50"]
+    if config == "gated":
+        # the gated row's real currency: effective elem/s vs the ungated
+        # A/B, plus the skip fraction that earned it (ISSUE 8 acceptance:
+        # >= 5x at n/k >= 10^4 on the host path, 10x targeted on TPU)
+        record["stages"] = gated_stages
+        record["speedup"] = round(gated_stages["speedup"], 3)
+        record["skip_frac"] = round(gated_stages["skip_frac"], 5)
+        record["bytes_per_elem_shipped"] = gated_stages[
+            "bytes_per_elem_shipped"
+        ]
     if config == "traffic":
         # the traffic row's real currency: corrected wait + SLO verdicts
         record["stages"] = traffic_stages

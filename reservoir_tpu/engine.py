@@ -167,6 +167,11 @@ class ReservoirEngine:
         #: runtime Pallas failures absorbed by demoting to XLA (0 or 1 —
         #: the first demotion is permanent for this engine)
         self.demotions = 0
+        #: row resets applied so far (reset_rows calls).  The ingest-side
+        #: skip gate (ISSUE 8) keys its host replica's staleness on this:
+        #: a serve-plane row recycle mutates (count, nxt, log_w) behind the
+        #: gate's back, and the replica must re-pull before its next eval.
+        self.reset_epochs = 0
         self._mesh = None
         self._tile_sharding = None
         self._row_sharding = None
@@ -739,6 +744,90 @@ class ReservoirEngine:
             )
             self._min_count += int(valid_np.min())
 
+    def sample_gated(self, tile: Any, nvalid: Any, advance: Any) -> None:
+        """Consume one PRE-GATED ``[R, Bg]`` candidate tile (ISSUE 8).
+
+        The ingest-side skip gate (:mod:`reservoir_tpu.stream.gate`) ships
+        only the elements that can win: row ``r`` advances by
+        ``advance[r]`` logical stream elements of which the ``nvalid[r]``
+        candidates in ``tile[r, :nvalid[r]]`` (fill-phase prefix + every
+        Algorithm-L acceptance, in order) were shipped.  Bit-identical to
+        :meth:`sample` over the full tiles — acceptance draws are keyed on
+        the same absolute indices either way (:func:`ops.algorithm_l.update_gated`).
+
+        Duplicates mode with narrow int32 counters on an unmeshed engine
+        only — exactly the :func:`~reservoir_tpu.stream.gate.gate_ineligible_reason`
+        contract; the gated apply always takes the XLA path (candidate
+        tiles are too small to feed a Mosaic grid).
+        """
+        self._check_open()
+        _faults.fire("engine.update", self._faults)
+        if self._ops is not _algl:
+            raise ValueError(
+                "sample_gated requires duplicates mode (the skip gate "
+                "replicates the Algorithm-L recursion only)"
+            )
+        if self._state.count.ndim != 1 or (
+            self._state.count.dtype != jnp.int32
+        ):
+            raise ValueError(
+                "sample_gated requires narrow int32 counters"
+            )
+        if self._mesh is not None:
+            raise ValueError("sample_gated does not support meshed engines")
+        R = self._config.num_reservoirs
+        # snapshot (gated tiles are small): async-device_put safe even if
+        # the caller reuses its buffer, the discipline sample() keeps
+        tile_host = np.array(tile, order="C")
+        if tile_host.ndim != 2 or tile_host.shape[0] != R:
+            raise ValueError(
+                f"gated tile must be [num_reservoirs={R}, Bg], got "
+                f"{tile_host.shape}"
+            )
+        bg = tile_host.shape[1]
+        nvalid_np = np.array(nvalid, np.int32, copy=True)
+        advance_np = np.array(advance, np.int32, copy=True)
+        if nvalid_np.shape != (R,) or advance_np.shape != (R,):
+            raise ValueError(
+                f"nvalid/advance must be [{R}], got {nvalid_np.shape} / "
+                f"{advance_np.shape}"
+            )
+        if np.any(nvalid_np < 0) or np.any(nvalid_np > bg):
+            raise ValueError(
+                f"nvalid entries must be in [0, {bg}], got "
+                f"[{nvalid_np.min()}, {nvalid_np.max()}]"
+            )
+        if np.any(advance_np < 0):
+            raise ValueError("advance entries must be nonnegative")
+        canon = jax.dtypes.canonicalize_dtype(tile_host.dtype)
+        if tile_host.dtype != canon:
+            tile_host = tile_host.astype(canon)
+        cache_key = ("gated", bg, False, False)  # [3] = use_pallas: False
+        fn = self._jit_cache.get(cache_key)
+        if fn is None:
+            shared_key = (
+                (self._ops, "gated") if self._map_fn is None else None
+            )
+            if shared_key is not None:
+                fn = _SHARED_UPDATE_JIT.get(shared_key)
+            if fn is None:
+                fn = jax.jit(
+                    functools.partial(
+                        _algl.update_gated, map_fn=self._map_fn
+                    ),
+                    donate_argnums=(0,),
+                )
+                if shared_key is not None:
+                    _SHARED_UPDATE_JIT[shared_key] = fn
+            self._jit_cache[cache_key] = fn
+        placed = jax.device_put(
+            {"tile": tile_host, "nvalid": nvalid_np, "advance": advance_np}
+        )
+        self._state = fn(
+            self._state, placed["tile"], placed["nvalid"], placed["advance"]
+        )
+        self._min_count += int(advance_np.min())
+
     def sample_all(self, tiles: Any) -> None:
         """Consume an iterable of tiles (bulk path, ``Sampler.scala:341``).
 
@@ -1030,6 +1119,7 @@ class ReservoirEngine:
                 self._state, self._mesh, self._config.mesh_axis
             )
         self._min_count = 0
+        self.reset_epochs += 1
 
     # ----------------------------------------------------------- checkpoints
 

@@ -431,6 +431,14 @@ class NativeStaging:
             return self._lib.rsv_staging_fill(self._handle, int(stream)) >= self._B
         return int(self._fill[stream]) >= self._B
 
+    def fill(self, stream: int) -> int:
+        """O(1) staged-element count of one row.  The skip gate's push
+        fast path (ISSUE 8) requires an EMPTY row — staged residue would
+        put the host replica behind the row's true stream position."""
+        if self._lib is not None:
+            return int(self._lib.rsv_staging_fill(self._handle, int(stream)))
+        return int(self._fill[stream])
+
     def drain(self, out_tile: np.ndarray, out_valid: np.ndarray,
               out_weights: Optional[np.ndarray] = None) -> int:
         """Copy staged rows + fill counts into caller buffers and reset;

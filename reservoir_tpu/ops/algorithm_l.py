@@ -45,6 +45,7 @@ __all__ = [
     "init",
     "update",
     "update_steady",
+    "update_gated",
     "result",
     "merge",
 ]
@@ -376,6 +377,82 @@ def update_steady(
     the engine does this automatically).  Skipping the masked fill scatter
     saves a [B]-wide scatter per reservoir per tile."""
     return _update(state, batch, valid, map_fn, fill=False)
+
+
+def _update_gated_one(
+    samples, count, nxt, log_w, key, row, nvalid, advance, k: int,
+    map_fn: Optional[Callable],
+):
+    """Single-reservoir gated apply (vmapped over R by :func:`update_gated`).
+
+    ``row[:nvalid]`` holds exactly the CANDIDATES of this reservoir's next
+    ``advance`` logical elements, in stream order: first the fill-phase
+    prefix (absolute indices ``count+1 .. min(k, count+advance)``), then
+    every Algorithm-L acceptance in ``(count, count+advance]``.  Skipped
+    elements were never shipped — the host gate proved (by running THIS
+    recursion) that no acceptance lands on them.
+    """
+    bg = row.shape[0]
+    # fill prefix: the first f shipped elements land in slots
+    # count..count+f-1, exactly the ungated fill scatter's destinations
+    f = jnp.clip(jnp.asarray(k, count.dtype) - count, 0, advance).astype(
+        jnp.int32
+    )
+    lane = jnp.arange(bg, dtype=jnp.int32)
+    dest = jnp.where(lane < f, count.astype(jnp.int32) + lane, k)
+    values = map_fn(row) if map_fn is not None else row
+    samples = samples.at[dest].set(
+        jnp.asarray(values, samples.dtype), mode="drop"
+    )
+
+    def cond(carry):
+        return carry[3] < nvalid
+
+    def body(carry):
+        samples_c, nxt_c, log_w_c, j = carry
+        elem = row[j]
+        # the j-th candidate IS the acceptance at absolute index nxt —
+        # identical draws (same Threefry blocks) to the ungated loop
+        slot, log_w_n, nxt_n = _advance(log_w_c, nxt_c, key, nxt_c, k)
+        value = map_fn(elem) if map_fn is not None else elem
+        samples_n = samples_c.at[slot].set(jnp.asarray(value, samples_c.dtype))
+        return samples_n, nxt_n, log_w_n, j + 1
+
+    samples, nxt, log_w, _ = jax.lax.while_loop(
+        cond, body, (samples, nxt, log_w, f)
+    )
+    return samples, count + advance.astype(count.dtype), nxt, log_w
+
+
+def update_gated(
+    state: ReservoirState,
+    batch: jax.Array,
+    nvalid: jax.Array,
+    advance: jax.Array,
+    map_fn: Optional[Callable] = None,
+) -> ReservoirState:
+    """Consume one PRE-GATED ``[R, Bg]`` candidate tile (ISSUE 8).
+
+    The ingest-side skip-ahead gate (:mod:`reservoir_tpu.stream.gate`) runs
+    this module's own skip recursion host-side and ships only the elements
+    that can win: reservoir ``r`` advances by ``advance[r]`` logical
+    elements of which only the ``nvalid[r]`` candidates in
+    ``batch[r, :nvalid[r]]`` were shipped (fill-phase prefix + every
+    acceptance, in order).  Bit-identical to :func:`update` over the full
+    tiles by construction — the acceptance draws are keyed on the same
+    absolute indices, skipped elements consume no draws either way — and
+    pinned by ``tests/test_gate.py``.  Narrow (non-WIDE) counters only.
+    """
+    if state.wide:
+        raise ValueError("update_gated requires narrow (non-WIDE) counters")
+    k = state.k
+    samples, count, nxt, log_w = jax.vmap(
+        functools.partial(_update_gated_one, k=k, map_fn=map_fn)
+    )(
+        state.samples, state.count, state.nxt, state.log_w, state.key,
+        batch, nvalid, advance,
+    )
+    return ReservoirState(samples, count, nxt, log_w, state.key)
 
 
 def _wide_size(count: jax.Array, k: int) -> jax.Array:

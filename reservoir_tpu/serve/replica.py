@@ -102,11 +102,19 @@ class JournalFollower:
         self._seq = int(start_seq)
         self._offset = 0
         self._offset_seq = 0
+        # byte offset where the record ending at the cursor STARTS —
+        # tracked explicitly because gated frames (ISSUE 8) make journal
+        # records variable-size, so the predecessor probe can no longer
+        # assume a fixed stride
+        self._offset_start: Optional[int] = None
+        self._starts: dict = {}
         self._max = int(max_records)
         self._faults = faults
         n_payload = self._S * 4 + self._S * self._B * (
             self._dtype.itemsize + (4 if weighted else 0)
         )
+        # a PLAIN frame's size: the largest frame a non-gated primary
+        # writes; used as the conservative misalignment bound below
         self._record_nbytes = _FlushJournal._HEADER.size + n_payload + 4
 
     @property
@@ -123,20 +131,29 @@ class JournalFollower:
         self._seq = int(seq)
         self._offset = int(offset)
         self._offset_seq = int(seq)
+        start = self._starts.get(int(offset))
+        if start is not None:
+            self._offset_start = start
 
     def rewind(self, seq: int) -> None:
         """Reset after a re-bootstrap: scan from byte 0, skipping records
         the fresh checkpoint covers (``seq`` is its watermark)."""
         self._seq = int(seq)
         self._offset = 0
+        self._offset_start = None
 
     def _cursor_valid(self) -> bool:
         """Whether the record ending at the cursor is still the one we
         read there.  Rotation truncates the journal and new records land
-        at the same byte offsets (frames are fixed-size per config), so a
-        size check alone cannot detect it — re-read the header of the
-        cursor's predecessor record and compare its sequence number."""
-        start = self._offset - self._record_nbytes
+        at reusable byte offsets, so a size check alone cannot detect it —
+        re-read the header of the record ending at the cursor (its start
+        offset is tracked per ack: gated frames make records
+        variable-size) and compare its sequence number."""
+        start = (
+            self._offset_start
+            if self._offset_start is not None
+            else self._offset - self._record_nbytes
+        )
         if start < 0:
             return False
         try:
@@ -148,19 +165,27 @@ class JournalFollower:
         if len(head) < _FlushJournal._HEADER.size:
             return False
         magic, seq, _ = _FlushJournal._HEADER.unpack(head)
-        return magic == _FlushJournal._MAGIC and seq == self._offset_seq
+        return magic in (
+            _FlushJournal._MAGIC, _FlushJournal._MAGIC_GATED
+        ) and seq == self._offset_seq
 
     def poll(
         self,
     ) -> Tuple[
-        List[Tuple[int, int, np.ndarray, np.ndarray, Optional[np.ndarray]]],
+        List[
+            Tuple[
+                int, int, np.ndarray, np.ndarray, Optional[np.ndarray],
+                Optional[np.ndarray],
+            ]
+        ],
         bool,
         bool,
     ]:
         """Read intact records past the cursor.
 
         Returns ``(records, rotated, gap)``: ``records`` is a list of
-        ``(end_offset, seq, tile, valid, wtile)`` in sequence order;
+        ``(end_offset, seq, tile, valid, wtile, advance)`` in sequence
+        order (``advance`` non-None marks a gated frame, ISSUE 8);
         ``rotated`` flags a detected journal rotation (file shrank below
         the cursor); ``gap`` means an intact record was found whose seq
         skips past the cursor — records were lost to a rotation and the
@@ -176,9 +201,12 @@ class JournalFollower:
         if self._offset and (size < self._offset or not self._cursor_valid()):
             rotated = True
             self._offset = 0
+            self._offset_start = None
         records: List = []
         gap = False
-        for end, seq, tile, valid, wtile in _FlushJournal.read_records(
+        prev_end = self._offset
+        starts: dict = {}
+        for end, seq, tile, valid, wtile, adv in _FlushJournal.read_records(
             self._path,
             self._S,
             self._B,
@@ -186,17 +214,21 @@ class JournalFollower:
             self._weighted,
             offset=self._offset,
         ):
+            start, prev_end = prev_end, end
             if seq <= self._seq:
                 # already applied (post-rotation rescan): skip permanently
                 self._offset = end
                 self._offset_seq = seq
+                self._offset_start = start
                 continue
             if seq != self._seq + len(records) + 1:
                 gap = True
                 break
-            records.append((end, seq, tile, valid, wtile))
+            records.append((end, seq, tile, valid, wtile, adv))
+            starts[end] = start
             if len(records) >= self._max:
                 break
+        self._starts = starts
         if not records and not gap and self._offset:
             # Misalignment detector: a rotation can go unnoticed when the
             # new journal grows past the old cursor (size never dipped
@@ -534,15 +566,19 @@ class StandbyReplica:
             return applied
         if records:
             self._target_seq = max(self._target_seq, records[-1][1])
-        for end, seq, tile, valid, wtile in records:
+        for end, seq, tile, valid, wtile, advance in records:
             try:
                 _faults.fire("replica.apply", self._faults)
                 # the exact replay path recover() uses — bit-exact by
-                # construction (counter-keyed draws)
+                # construction (counter-keyed draws); gated frames apply
+                # through the same gated engine path (ISSUE 8)
                 reg = _obs.get()
                 t0 = time.perf_counter() if reg is not None else 0.0
                 with trace_span("reservoir_replica_apply"):
-                    self._engine.sample(tile, valid=valid, weights=wtile)
+                    if advance is not None:
+                        self._engine.sample_gated(tile, valid, advance)
+                    else:
+                        self._engine.sample(tile, valid=valid, weights=wtile)
                 if reg is not None:
                     reg.histogram("replica.apply_s").observe(
                         time.perf_counter() - t0

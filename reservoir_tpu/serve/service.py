@@ -131,12 +131,19 @@ class ReservoirService:
         hooks are zero-overhead no-ops while telemetry is disabled
         (pinned by the trip-wire in ``tests/test_obs.py``).
       pipelined / retry_policy / flush_timeout_s / checkpoint_dir /
-        checkpoint_every / durability / faults: forwarded to the
-        underlying :class:`DeviceStreamBridge` (the ISSUE-3/5 robustness
-        plane).  With ``checkpoint_dir`` set the service additionally
+        checkpoint_every / durability / faults / gated / gate_tile:
+        forwarded to the underlying :class:`DeviceStreamBridge` (the
+        ISSUE-3/5 robustness plane; ``gated`` is the ISSUE-8 ingest-side
+        skip gate).  With ``checkpoint_dir`` set the service additionally
         journals the session map to ``sessions.jsonl`` there, which is
         what makes :meth:`recover` (and hot-standby replication,
         :class:`~reservoir_tpu.serve.replica.StandbyReplica`) possible.
+        Admission control is deliberately PRE-gate: ``coalesce_bytes`` /
+        ``max_inflight_bytes`` bound the raw ingested bytes and
+        ``flush_would_block`` probes pipeline permits, so enabling the
+        gate changes neither the rejection threshold nor what
+        ``ServiceSaturated.retry_after_s`` means (pinned by
+        ``tests/test_gate.py``).
     """
 
     def __init__(
@@ -158,6 +165,8 @@ class ReservoirService:
         checkpoint_every: int = 64,
         durability: str = "buffered",
         faults: Optional[Any] = None,
+        gated: bool = False,
+        gate_tile: int = 64,
         _bridge: Optional[DeviceStreamBridge] = None,
         _table: Optional[SessionTable] = None,
     ) -> None:
@@ -182,6 +191,8 @@ class ReservoirService:
             checkpoint_every=checkpoint_every,
             durability=durability,
             faults=faults,
+            gated=gated,
+            gate_tile=gate_tile,
         )
         config = self._bridge._config
         self._config = config

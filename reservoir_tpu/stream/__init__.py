@@ -14,9 +14,21 @@ value is a ``Future`` of the final sample, with a precise completion protocol
   batching layer: S logical streams buffered into ``[R, B]`` tiles feeding a
   :class:`~reservoir_tpu.engine.ReservoirEngine` (the 65,536-stream scale
   path, BASELINE.md config 5).
+- :class:`~reservoir_tpu.stream.gate.SkipGate` — the ingest-side skip-ahead
+  gate (ISSUE 8): a host replica of the Algorithm-L skip recursion that
+  lets a ``gated=True`` bridge elide, compact and coalesce everything that
+  cannot be accepted, bit-reconcilably.
 """
 
 from .bridge import DeviceSampler, DeviceStreamBridge
+from .gate import SkipGate, gate_ineligible_reason
 from .operator import RunningSample, Sample
 
-__all__ = ["Sample", "RunningSample", "DeviceStreamBridge", "DeviceSampler"]
+__all__ = [
+    "Sample",
+    "RunningSample",
+    "DeviceStreamBridge",
+    "DeviceSampler",
+    "SkipGate",
+    "gate_ineligible_reason",
+]

@@ -66,6 +66,7 @@ from ..errors import (
 from ..native import NativeStaging
 from ..obs import registry as _obs
 from ..utils import faults as _faults
+from .gate import SkipGate, gate_ineligible_reason
 from ..utils.checkpoint import read_epoch
 from ..utils.log import warn_once
 from ..utils.metrics import BridgeMetrics
@@ -302,6 +303,16 @@ class _FlushJournal:
     rotation is safe: recovery filters out records the checkpoint already
     covers instead of double-applying them.
 
+    Gated bridges (ISSUE 8) additionally journal **gated frames**
+    (``MAGIC = RTJG``): ``valid`` is the per-row candidate count, the tile
+    is the compacted ``[S, Bg]`` candidate tile, and an extra int32[S]
+    ``advance`` array carries each row's total logical consumption — the
+    journal then stores only the bytes that can win, and replay re-applies
+    them through :meth:`ReservoirEngine.sample_gated` bit-exactly.  The
+    gate-tile width ``Bg`` is recovered from the frame length, so readers
+    need no extra metadata and mixed gated/ungated journals replay in
+    order.
+
     Durability (ISSUE 5 satellite): ``fsync=True`` additionally fsyncs
     every appended frame (and the file+directory on rotation), closing the
     OS/power-crash window the buffered default concedes above — at the
@@ -309,6 +320,7 @@ class _FlushJournal:
     """
 
     _MAGIC = b"RTJL"
+    _MAGIC_GATED = b"RTJG"
     _HEADER = struct.Struct("<4sQI")
 
     def __init__(
@@ -351,7 +363,23 @@ class _FlushJournal:
         payload = valid.tobytes() + tile.tobytes()
         if wtile is not None:
             payload += wtile.tobytes()
-        self._fh.write(self._HEADER.pack(self._MAGIC, seq, len(payload)))
+        self._append_frame(self._MAGIC, seq, payload)
+
+    def append_gated(
+        self,
+        seq: int,
+        tile: np.ndarray,
+        nvalid: np.ndarray,
+        advance: np.ndarray,
+    ) -> None:
+        """One gated frame (ISSUE 8): candidate counts + per-row logical
+        advance + the compacted ``[S, Bg]`` candidate tile — the journal's
+        share of the bytes-elided win."""
+        payload = nvalid.tobytes() + advance.tobytes() + tile.tobytes()
+        self._append_frame(self._MAGIC_GATED, seq, payload)
+
+    def _append_frame(self, magic: bytes, seq: int, payload: bytes) -> None:
+        self._fh.write(self._HEADER.pack(magic, seq, len(payload)))
         self._fh.write(payload)
         self._fh.write(struct.pack("<I", zlib.crc32(payload)))
         self._fh.flush()
@@ -388,12 +416,19 @@ class _FlushJournal:
         weighted: bool,
         offset: int = 0,
     ) -> Iterator[
-        Tuple[int, int, np.ndarray, np.ndarray, Optional[np.ndarray]]
+        Tuple[
+            int, int, np.ndarray, np.ndarray, Optional[np.ndarray],
+            Optional[np.ndarray],
+        ]
     ]:
-        """Yield ``(end_offset, seq, tile, valid, wtile)`` for every intact
-        record starting at byte ``offset``, stopping cleanly at the first
-        truncated/corrupt frame.  ``end_offset`` is the byte cursor AFTER
-        the yielded record — the resumable-tail API the HA plane's
+        """Yield ``(end_offset, seq, tile, valid, wtile, advance)`` for
+        every intact record starting at byte ``offset``, stopping cleanly
+        at the first truncated/corrupt frame.  ``advance`` is None for
+        plain frames; for gated frames (ISSUE 8) it is the per-row int32
+        logical advance, ``valid`` is the candidate count and ``tile`` the
+        compacted ``[S, Bg]`` candidate tile (``Bg`` recovered from the
+        frame length).  ``end_offset`` is the byte cursor AFTER the
+        yielded record — the resumable-tail API the HA plane's
         :class:`~reservoir_tpu.serve.replica.JournalFollower` polls (a torn
         tail is retried from its start offset on the next poll, never
         treated as permanent corruption: the primary may be mid-append)."""
@@ -413,7 +448,15 @@ class _FlushJournal:
                 if len(head) < cls._HEADER.size:
                     return
                 magic, seq, plen = cls._HEADER.unpack(head)
-                if magic != cls._MAGIC or plen != expect:
+                if magic == cls._MAGIC:
+                    if plen != expect:
+                        return
+                elif magic == cls._MAGIC_GATED:
+                    # gated frames carry their own width: Bg from plen
+                    rem = plen - 2 * n_valid
+                    if rem < 0 or rem % (S * dtype.itemsize):
+                        return
+                else:
                     return
                 payload = fh.read(plen)
                 crc = fh.read(4)
@@ -421,6 +464,19 @@ class _FlushJournal:
                     return
                 if zlib.crc32(payload) != struct.unpack("<I", crc)[0]:
                     return
+                if magic == cls._MAGIC_GATED:
+                    bg = (plen - 2 * n_valid) // (S * dtype.itemsize)
+                    nvalid = np.frombuffer(payload, np.int32, S).copy()
+                    advance = np.frombuffer(
+                        payload, np.int32, S, n_valid
+                    ).copy()
+                    gtile = (
+                        np.frombuffer(payload, dtype, S * bg, 2 * n_valid)
+                        .reshape(S, bg)
+                        .copy()
+                    )
+                    yield fh.tell(), int(seq), gtile, nvalid, None, advance
+                    continue
                 valid = np.frombuffer(payload, np.int32, S).copy()
                 tile = (
                     np.frombuffer(payload, dtype, S * B, n_valid)
@@ -434,18 +490,24 @@ class _FlushJournal:
                     if weighted
                     else None
                 )
-                yield fh.tell(), int(seq), tile, valid, wtile
+                yield fh.tell(), int(seq), tile, valid, wtile, None
 
     @classmethod
     def replay(
         cls, path: str, num_streams: int, tile_width: int, dtype, weighted: bool
-    ) -> Iterator[Tuple[int, np.ndarray, np.ndarray, Optional[np.ndarray]]]:
-        """Yield ``(seq, tile, valid, wtile)`` for every intact record,
-        stopping cleanly at the first truncated/corrupt one."""
-        for _, seq, tile, valid, wtile in cls.read_records(
+    ) -> Iterator[
+        Tuple[
+            int, np.ndarray, np.ndarray, Optional[np.ndarray],
+            Optional[np.ndarray],
+        ]
+    ]:
+        """Yield ``(seq, tile, valid, wtile, advance)`` for every intact
+        record (``advance`` non-None marks a gated frame), stopping
+        cleanly at the first truncated/corrupt one."""
+        for _, seq, tile, valid, wtile, advance in cls.read_records(
             path, num_streams, tile_width, dtype, weighted
         ):
-            yield seq, tile, valid, wtile
+            yield seq, tile, valid, wtile, advance
 
 
 class DeviceStreamBridge:
@@ -494,6 +556,32 @@ class DeviceStreamBridge:
         for the ``bridge.*``/``engine.*`` injection sites; ``None`` defers
         to the globally installed plane (``RESERVOIR_FAULTS``) — and when
         neither exists every site is a zero-overhead no-op.
+      gated: ingest-side skip-ahead gating (ISSUE 8, default off).  A
+        host-side replica of the Algorithm-L skip recursion
+        (:mod:`reservoir_tpu.stream.gate`) decides per staged chunk which
+        elements can still win; only those candidates (plus fill-phase
+        prefixes) are compacted into a small ``[S, gate_tile]`` tile,
+        journaled, and dispatched — bit-identical reservoirs to the
+        ungated path, a fraction of the bytes.  Eligible in duplicates
+        mode with int32 counters on an unmeshed engine; elsewhere
+        (weighted/distinct/WIDE/mesh) the flag is INERT — same results,
+        no elision (``gate_active`` says which).  Chunks whose candidates
+        overflow ``gate_tile`` (the fill phase, mostly) fall back to the
+        ungated dispatch for that flush, still bit-exact.
+      gate_tile: candidate-tile width ``Bg`` of the gated dispatch path
+        (default 64): per gated dispatch, each row ships at most this many
+        candidates; acceptance-free flushes coalesce until some row's
+        buffer fills or a visibility barrier (:meth:`flush`,
+        :meth:`complete`, a serve-plane ``sync``) forces the dispatch.
+      gate_push_chunk: slice width of the PRE-staging push fast path
+        (default 1 Mi elements): a row-contiguous :meth:`push` chunk is
+        gated in slices of this many elements — one vectorized recursion
+        eval per slice, candidates gathered straight from the producer's
+        array, elided elements never even demuxed.  A slice whose
+        candidates exceed ``gate_tile`` (fill phase, early stream)
+        automatically reroutes through the staged path; wide slices
+        amortize the per-eval call cost, which dominates the gated hot
+        path once everything else is elided.
     """
 
     def __init__(
@@ -512,6 +600,9 @@ class DeviceStreamBridge:
         checkpoint_every: int = 64,
         durability: str = "buffered",
         faults: Optional[Any] = None,
+        gated: bool = False,
+        gate_tile: int = 64,
+        gate_push_chunk: int = 1 << 20,
         _engine: Optional[ReservoirEngine] = None,
     ) -> None:
         if durability not in ("buffered", "fsync"):
@@ -571,6 +662,20 @@ class DeviceStreamBridge:
                 self._tiles[0],
                 self._wtiles[0] if self._wtiles is not None else None,
             )
+        # ingest-side skip-ahead gate (ISSUE 8): constructed only when
+        # requested AND eligible — an inert gate costs nothing, an active
+        # one evaluates the host replica per flush and coalesces candidates
+        self._gate: Optional[SkipGate] = None
+        self._gate_reason: Optional[str] = None
+        if gated:
+            self._gate_reason = gate_ineligible_reason(config)
+            if self._gate_reason is None:
+                self._gate = SkipGate(
+                    S, config.max_sample_size, B, dtype, cap=gate_tile
+                )
+        self._gate_tile = int(gate_tile)
+        self._gate_push_chunk = max(1, int(gate_push_chunk))
+        self._gated_requested = bool(gated)
         self._future: Future = Future()
         self._metrics = BridgeMetrics()
         self._metrics.demux_threads = self._staging.threads()
@@ -647,6 +752,19 @@ class DeviceStreamBridge:
         return self._metrics
 
     @property
+    def gate_active(self) -> bool:
+        """Whether the ingest-side skip gate is live (``gated=True`` AND
+        the config is eligible — see :attr:`gate_inert_reason`)."""
+        return self._gate is not None
+
+    @property
+    def gate_inert_reason(self) -> Optional[str]:
+        """Why a requested gate is inert (None when active or never
+        requested) — ``weighted``/``distinct``/WIDE/meshed configs take
+        the ungated path with identical results."""
+        return self._gate_reason
+
+    @property
     def is_open(self) -> bool:
         return self._engine.is_open and not self._future.done()
 
@@ -682,8 +800,15 @@ class DeviceStreamBridge:
                 f"{self._tiles[0].dtype}: {e}"
             ) from None
         warr = self._check_weights(arr, weights, stream=int(stream))
-        off = 0
         n = arr.shape[0]
+        if self._gate is not None and warr is None:
+            # pre-staging fast path (ISSUE 8): a row-contiguous chunk is
+            # gated BEFORE any staging copy — elided elements never cost
+            # a demux byte, let alone a DMA one
+            self._gate_push(int(stream), arr)
+            self._metrics.elements += n
+            return
+        off = 0
         while off < n:
             t0 = time.perf_counter()
             took = self._staging.push_chunk(
@@ -694,7 +819,10 @@ class DeviceStreamBridge:
             self._metrics.demux_s += time.perf_counter() - t0
             off += took
             if off < n or self._staging.row_full(stream):
-                self.flush()
+                # internal row-full flush: gated bridges may coalesce it
+                # into the candidate buffer (no dispatch) — the public
+                # flush()/complete() barriers force the dispatch
+                self._flush_staging()
         self._metrics.elements += n
 
     def push_interleaved(self, streams: Any, elements: Any,
@@ -723,7 +851,7 @@ class DeviceStreamBridge:
             self._metrics.demux_s += time.perf_counter() - t0
             off += took
             if off < n:
-                self.flush()
+                self._flush_staging()
         self._metrics.elements += n
 
     def _check_weights(self, arr, weights, stream: Optional[int] = None):
@@ -759,6 +887,12 @@ class DeviceStreamBridge:
         self._check_open()
         self._check_fence()
         self._metrics.start()
+        if self._gate is not None:
+            # pre-assembled tiles bypass the gate: ship the coalesced
+            # candidate buffer first (stream order), then mark the host
+            # replica stale — it re-pulls before the next gated eval
+            self._dispatch_gated_pending()
+            self._gate.mark_dirty()
         self.drain_barrier()  # engine is single-writer: wait out the worker
         tile = np.asarray(tile)
         if self._journal is not None:
@@ -802,18 +936,25 @@ class DeviceStreamBridge:
         self._metrics.demotions = self._engine.demotions
         self._maybe_checkpoint()
 
-    def _dispatch_flush(self, tile, valid, wtile) -> None:
+    def _dispatch_flush(self, tile, valid, wtile, advance=None) -> None:
         """The device half of a flush (worker thread when pipelined).
 
         The ``bridge.dispatch`` fault site fires BEFORE the engine update:
         an injected transient failure is retried by the pipeline worker
         and, because engine state only advances on a successful update,
         the retried stream completes bit-identical to a clean run.
+
+        ``advance`` non-None marks a GATED flush (ISSUE 8): ``tile`` is
+        the compacted candidate tile, ``valid`` the per-row candidate
+        counts, and each row additionally advances by ``advance[r]``
+        logical elements — :meth:`ReservoirEngine.sample_gated`.
         """
         _faults.fire("bridge.dispatch", self._faults)
         t0 = time.perf_counter()
         with trace_span("reservoir_bridge_flush"):
-            if wtile is not None:
+            if advance is not None:
+                self._engine.sample_gated(tile, valid, advance)
+            elif wtile is not None:
                 # stale weight-slots past each row's valid count hold old
                 # (nonnegative) weights; the valid mask keeps them out of
                 # sampling and user weights are never rewritten (the r1
@@ -834,7 +975,10 @@ class DeviceStreamBridge:
         self._metrics.demotions = self._engine.demotions
 
     def flush(self) -> None:
-        """Dispatch buffered elements (ragged tile) to the device.
+        """Dispatch buffered elements (ragged tile) to the device — the
+        public visibility barrier: after it returns (plus
+        :meth:`drain_barrier`), every pushed element has been dispatched,
+        including a gated bridge's coalesced candidate buffer.
 
         Zero-copy mode (the default): the demux already scattered into the
         active host tile, so the flush reads the fill counts, hands the
@@ -844,6 +988,13 @@ class DeviceStreamBridge:
         tile first.  Either way the next demux overlaps this flush's
         transfer+dispatch when pipelined.
         """
+        self._flush_staging()
+        if self._gate is not None:
+            self._dispatch_gated_pending()
+
+    def _flush_staging(self) -> None:
+        """One staging flush (the internal row-full path): gated bridges
+        may absorb it into the candidate buffer without any dispatch."""
         # fence BEFORE any staging drain or journal append: a fenced
         # primary must fail fast with nothing mutated (ISSUE 5)
         self._check_fence()
@@ -855,6 +1006,11 @@ class DeviceStreamBridge:
             total = self._staging.take(valid)
             self._metrics.drain_s += time.perf_counter() - t0
             if total == 0:
+                return
+            if self._gate is not None and self._gate_flush(tile, valid):
+                # candidates buffered (possibly dispatched); the staging
+                # tile was fully consumed by the gather — keep demuxing
+                # into it, no swap needed
                 return
             # journal BEFORE handing the tile to the worker: the producer
             # still owns it here (the worker reads the other tile), and a
@@ -895,6 +1051,15 @@ class DeviceStreamBridge:
             if self._pipeline is not None:
                 self._pipeline.release()
             return
+        if self._gate is not None:
+            if self._pipeline is not None:
+                # the gate path manages its own reservations (a gated
+                # dispatch reserves inside _dispatch_gated_pending)
+                self._pipeline.release()
+            if self._gate_flush(tile, valid):
+                return
+            if self._pipeline is not None:
+                self._pipeline.reserve()  # re-acquire for the fallback
         self._flush_seq += 1
         if self._journal is not None:
             self._journal_append(self._flush_seq, tile, valid, wtile)
@@ -906,6 +1071,166 @@ class DeviceStreamBridge:
         self._metrics.flushes += 1
         self._metrics.flushed_elements += total
         self._maybe_checkpoint()
+
+    # ------------------------------------------------------- skip-ahead gate
+
+    def _gate_push(self, stream: int, arr: np.ndarray) -> None:
+        """Gate a row-contiguous pushed chunk BEFORE staging (ISSUE 8).
+
+        The chunk is evaluated in ``gate_push_chunk``-element slices: one
+        vectorized recursion eval decides each slice's candidates, which
+        are gathered straight from the producer's array into the
+        coalescing buffer — elided elements are never demuxed, staged,
+        journaled or DMA'd.  Candidate-dense slices (the fill phase,
+        early stream) are routed to the ordinary staged path, whose
+        flushes re-evaluate tile-by-tile; row order is preserved because
+        the fast path only runs while the row's staging is empty."""
+        gate = self._gate
+        if gate.stale(self._engine):
+            self.drain_barrier()
+            gate.resync(self._engine)
+        m = self._metrics
+        n = int(arr.shape[0])
+        off = 0
+        while off < n:
+            if self._staging.fill(stream):
+                # staged residue (a fallback slice's partial row): keep
+                # this slice on the staged path so the row stays ordered
+                off += self._push_staged(stream, arr[off:])
+                continue
+            self._check_fence()
+            take = min(n - off, self._gate_push_chunk)
+            chunk = arr[off : off + take]
+            reg = _obs.get()
+            t0 = time.perf_counter()
+            ev = gate.evaluate_row(stream, take)
+            dt = time.perf_counter() - t0
+            m.gate_eval_s += dt
+            if reg is not None:
+                reg.histogram("gate.eval_s").observe(dt)
+            if int(ev.n_cand[stream]) > gate.cap:
+                # candidate-dense slice: NOT committed — the staged
+                # flushes re-run the recursion in tile pieces and commit
+                off += self._push_staged(stream, chunk)
+                continue
+            if not gate.fits_row(stream, ev):
+                self._dispatch_gated_pending()
+            gate.commit(ev)
+            elided = gate.append_row(stream, chunk, ev)
+            m.gate_buffered_flushes += 1
+            m.gate_bytes_elided += elided * arr.itemsize
+            if reg is not None:
+                reg.counter("gate.bytes_elided").inc(elided * arr.itemsize)
+            if gate.advance_high():
+                self._dispatch_gated_pending()
+            off += take
+
+    def _push_staged(self, stream: int, arr: np.ndarray) -> int:
+        """One staged-path step of a single-row push: stage what fits,
+        flush on row-full (the pre-gate push loop's body); returns the
+        element count consumed."""
+        t0 = time.perf_counter()
+        took = self._staging.push_chunk(stream, arr, None)
+        self._metrics.demux_s += time.perf_counter() - t0
+        if took < arr.shape[0] or self._staging.row_full(stream):
+            self._flush_staging()
+        return took
+
+    def _gate_flush(self, tile: np.ndarray, valid: np.ndarray) -> bool:
+        """Gate one staged chunk (ISSUE 8).  Returns True when the chunk
+        was fully absorbed by the gate (candidates buffered, possibly a
+        gated dispatch); False when the caller must take the ungated
+        fallback path for THIS tile (candidate overflow — fill phase,
+        mostly).  Either way the host replica has already advanced over
+        the chunk, so fallback tiles stay bit-consistent."""
+        gate = self._gate
+        if gate.stale(self._engine):
+            # the engine was mutated outside the gated path (recovery
+            # replay, push_tile, serve-plane row resets): re-pull the
+            # replica under the single-writer slot.  Every sanctioned
+            # mutation path dispatches the pending buffer BEFORE mutating
+            # (push_tile does, serve syncs before reset_rows), so a
+            # pending buffer here is a single-writer contract violation —
+            # resync() refuses it rather than reorder the stream.
+            self.drain_barrier()
+            gate.resync(self._engine)
+        m = self._metrics
+        reg = _obs.get()
+        t0 = time.perf_counter()
+        ev = gate.evaluate(valid)
+        dt = time.perf_counter() - t0
+        m.gate_eval_s += dt
+        if reg is not None:
+            reg.histogram("gate.eval_s").observe(dt)
+        # both branches consume the chunk at THIS granularity (buffered
+        # gated or shipped whole), so the replica advances either way
+        gate.commit(ev)
+        if ev.fallback:
+            # this chunk's candidates exceed the gate tile: ship it whole,
+            # but dispatch the buffered advance FIRST (stream order)
+            self._dispatch_gated_pending()
+            shipped = int(np.asarray(valid).sum()) * tile.itemsize
+            m.gate_bytes_shipped += shipped
+            if reg is not None:
+                reg.counter("gate.bytes_shipped").inc(shipped)
+                self._observe_skip_frac(reg)
+            return False
+        if not gate.fits(ev):
+            self._dispatch_gated_pending()
+        elided = gate.append(tile, valid, ev)
+        m.gate_buffered_flushes += 1
+        m.gate_bytes_elided += elided * tile.itemsize
+        if reg is not None:
+            reg.counter("gate.bytes_elided").inc(elided * tile.itemsize)
+        if gate.advance_high():
+            self._dispatch_gated_pending()
+        return True
+
+    def _dispatch_gated_pending(self) -> None:
+        """Dispatch the gate's coalesced candidate buffer as one gated
+        flush (journaled like any other flush; replay uses
+        :meth:`ReservoirEngine.sample_gated`).  No-op when empty."""
+        gate = self._gate
+        if gate is None or not gate.pending():
+            return
+        self._check_fence()
+        gtile, nvalid, advance, total_adv = gate.take()
+        self._flush_seq += 1
+        if self._journal is not None:
+            reg = _obs.get()
+            t0 = time.perf_counter() if reg is not None else 0.0
+            with trace_span("reservoir_journal_append"):
+                self._journal.append_gated(
+                    self._flush_seq, gtile, nvalid, advance
+                )
+            if reg is not None:
+                reg.histogram("bridge.journal_append_s").observe(
+                    time.perf_counter() - t0
+                )
+        if self._pipeline is not None:
+            self._pipeline.reserve()
+            self._pipeline.submit(gtile, nvalid, None, advance)
+        else:
+            self._dispatch_flush(gtile, nvalid, None, advance)
+        m = self._metrics
+        m.flushes += 1
+        m.gated_dispatches += 1
+        # the folded advance becomes durable here: journal (when enabled)
+        # now covers these elements, so they count as flushed
+        m.flushed_elements += total_adv
+        shipped = gtile.nbytes + nvalid.nbytes + advance.nbytes
+        m.gate_bytes_shipped += shipped
+        reg = _obs.get()
+        if reg is not None:
+            reg.counter("gate.bytes_shipped").inc(shipped)
+            self._observe_skip_frac(reg)
+        self._maybe_checkpoint()
+
+    def _observe_skip_frac(self, reg) -> None:
+        m = self._metrics
+        denom = m.gate_bytes_shipped + m.gate_bytes_elided
+        if denom:
+            reg.gauge("gate.skip_frac").set(m.gate_bytes_elided / denom)
 
     def _journal_append(self, seq, tile, valid, wtile) -> None:
         """Journal one flushed tile — traced (``reservoir_journal_append``
@@ -1046,6 +1371,8 @@ class DeviceStreamBridge:
                     "durability": self._durability,
                     "elements": self._metrics.elements,
                     "flushed_elements": self._metrics.flushed_elements,
+                    "gated": self._gated_requested,
+                    "gate_tile": self._gate_tile,
                 }
             },
         )
@@ -1098,6 +1425,8 @@ class DeviceStreamBridge:
         faults: Optional[Any] = None,
         *,
         durability: Optional[str] = None,
+        gated: Optional[bool] = None,
+        gate_tile: Optional[int] = None,
         replay_hook: Optional[Any] = None,
     ) -> "DeviceStreamBridge":
         """Reconstruct a crashed auto-checkpointing bridge from its
@@ -1153,6 +1482,14 @@ class DeviceStreamBridge:
                 else durability
             ),
             faults=faults,
+            gated=(
+                bool(info.get("gated", False)) if gated is None else gated
+            ),
+            gate_tile=(
+                int(info.get("gate_tile", 64))
+                if gate_tile is None
+                else gate_tile
+            ),
             _engine=engine,
         )
         covered = int(info["seq"])
@@ -1168,7 +1505,7 @@ class DeviceStreamBridge:
         config = engine.config
         if replay_hook is not None:
             replay_hook(bridge, covered)
-        for seq, tile, valid, wtile in _FlushJournal.replay(
+        for seq, tile, valid, wtile, advance in _FlushJournal.replay(
             os.path.join(checkpoint_dir, "journal.bin"),
             config.num_reservoirs,
             config.tile_size,
@@ -1177,8 +1514,14 @@ class DeviceStreamBridge:
         ):
             if seq <= covered:
                 continue
-            engine.sample(tile, valid=valid, weights=wtile)
-            total = int(valid.sum())
+            if advance is not None:
+                # gated frame (ISSUE 8): candidates + per-row advance
+                # replay through the same gated apply the live path used
+                engine.sample_gated(tile, valid, advance)
+                total = int(advance.sum())
+            else:
+                engine.sample(tile, valid=valid, weights=wtile)
+                total = int(valid.sum())
             bridge._flush_seq = seq
             m.flushes += 1
             m.elements += total

@@ -1,0 +1,328 @@
+"""Ingest-side skip-ahead gate: stop shipping bytes that can't win (ISSUE 8).
+
+Past the fill phase, Algorithm L accepts a vanishing fraction of elements —
+yet the bridge DMAs every staged byte to the device (ROADMAP item 3).
+Sanders et al., "Efficient Random Sampling — Parallel, Vectorized,
+Cache-Efficient, and Online" (arXiv:1610.05141) shows skip-count sampling
+runs at memory bandwidth when the skip recursion is evaluated in bulk, and
+BatchRNG (arXiv:1412.4825) that counter-based RNG batches cleanly for
+exactly this shape.  This module is that idea applied to the stream bridge:
+
+- :class:`SkipGate` keeps a host-side **replica** of the engine's per-row
+  Algorithm-L recursion ``(count, nxt, log_w)`` and advances it per staged
+  chunk with the *same* traced code the device runs
+  (:func:`~reservoir_tpu.ops.algorithm_l._advance_words`, Threefry draws
+  keyed on absolute indices) — jitted on the **host CPU backend**, never
+  numpy: numpy's ``log``/``exp``/``log1p`` differ from XLA in final ulps,
+  and one ulp flips a ``floor`` and diverges the whole counter chain.  On
+  CPU backends the replica is bit-identical to the engine *by construction*
+  (same compiled math); on TPU the host-CPU-vs-TPU transcendental parity is
+  an empirical capture question — the ``gated_parity`` row of the
+  ``parity_probe`` selftest pins it per hardware window.
+
+- Per flush, :meth:`evaluate` runs the recursion over all S rows in one
+  vmapped call and reports, per row, the **candidate set** of the staged
+  chunk: the fill-phase prefix plus every acceptance position.  Everything
+  else is provably irrelevant — those bytes are *elided*, never journaled,
+  never DMA'd.
+
+- Candidates coalesce into a small ``[S, gate_tile]`` tile across flushes
+  (:meth:`append`/:meth:`take`); the bridge dispatches it through
+  :meth:`ReservoirEngine.sample_gated` with a per-row ``advance`` count, so
+  hundreds of acceptance-free flushes collapse into one tiny dispatch.
+
+Bit-reconciliation contract (the discipline ``ops/prefix.py`` established
+for weights): the gated and ungated paths consume the same Threefry blocks
+per logical index and accept the same set, so reservoirs are bit-identical
+— pinned across chunk geometries, modes, crash-recovery replay and the
+serving plane by ``tests/test_gate.py``.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import numpy as np
+
+__all__ = ["SkipGate", "GateEval", "gate_ineligible_reason"]
+
+#: module-level jit cache keyed by (k, cap): every bridge of the same
+#: reservoir capacity shares one compiled eval (shape axes are jit's own
+#: cache dimensions) — a fresh gate must not pay a re-trace per instance.
+_EVAL_CACHE: dict = {}
+
+
+def gate_ineligible_reason(config, staging=None) -> Optional[str]:
+    """None when the skip gate can run for ``config``, else why not.
+
+    The gate replicates the *duplicates-mode* Algorithm-L recursion with
+    narrow int32 counters; weighted (A-ExpJ needs every weight to decide)
+    and distinct (every element's hash competes) modes, WIDE/int64
+    counters, and meshed engines stay on the ungated path.  A ``gated=True``
+    bridge in those modes is simply inert — same results, no elision.
+    """
+    if config.weighted:
+        return "weighted mode (A-ExpJ must see every weight)"
+    if config.distinct:
+        return "distinct mode (every element's hash competes)"
+    if config.count_dtype == "wide":
+        return "WIDE counters (gate replica is int32-narrow)"
+    if np.dtype(config.count_dtype) != np.int32:
+        return f"count_dtype {config.count_dtype!r} (gate replica is int32)"
+    if config.mesh_axis is not None:
+        return "meshed engine (gated dispatch is single-device)"
+    return None
+
+
+class GateEval(NamedTuple):
+    """One chunk's gate verdict (host arrays, per reservoir row).  Carries
+    the post-chunk replica state UNCOMMITTED — the caller commits when it
+    takes a gated path, or discards when it routes the chunk to the
+    staged path (whose flushes re-evaluate in tile-sized pieces)."""
+
+    pos: np.ndarray    #: [S, cap] int32 accept positions (first n_acc valid)
+    fill: np.ndarray   #: [S] int32 fill-phase prefix lengths
+    n_acc: np.ndarray  #: [S] int32 acceptance counts in the chunk
+    n_cand: np.ndarray  #: [S] int32 fill + n_acc
+    fallback: bool     #: some evaluated row's candidates overflow the tile
+    state: tuple       #: (count, nxt, log_w) jax CPU arrays post-chunk
+
+
+def _build_eval(k: int, cap: int):
+    """The jitted skip-recursion eval: vmapped over rows, one while_loop
+    per row running the SAME `_advance_words` trace the engine's accept
+    loop runs — that identity is the whole bit-reconciliation story."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..ops.algorithm_l import _advance_words
+
+    def one(count, nxt, log_w, k1, k2, m):
+        end = count + m
+
+        def cond(carry):
+            return carry[1] <= end
+
+        def body(carry):
+            pos, nxt_c, log_w_c, n = carry
+            p = (nxt_c - count - 1).astype(jnp.int32)
+            pos = pos.at[jnp.minimum(n, cap - 1)].set(p)
+            _, log_w_n, nxt_n = _advance_words(
+                log_w_c, nxt_c, k1, k2, nxt_c, k
+            )
+            return pos, nxt_n, log_w_n, n + 1
+
+        pos, nxt_f, log_w_f, n_acc = jax.lax.while_loop(
+            cond,
+            body,
+            (jnp.zeros((cap,), jnp.int32), nxt, log_w, jnp.int32(0)),
+        )
+        f = jnp.clip(k - count, 0, m).astype(jnp.int32)
+        return pos, f, n_acc, count + m, nxt_f, log_w_f
+
+    return jax.jit(jax.vmap(one))
+
+
+class SkipGate:
+    """Host-side skip-ahead replica + candidate coalescing buffer for one
+    :class:`~reservoir_tpu.stream.bridge.DeviceStreamBridge`.
+
+    Single-writer like the bridge that owns it.  The replica state is
+    authoritative only as a *predictor*: the device runs the identical
+    recursion over what ships, so a correct replica elides only bytes the
+    device would never have touched.  ``resync`` re-pulls the replica from
+    the live engine state; the bridge calls it lazily whenever the engine
+    was mutated behind the gate's back (construction, ``recover()`` replay,
+    ``push_tile``, serve-plane ``reset_rows`` — tracked through
+    ``engine.reset_epochs``).
+    """
+
+    def __init__(self, num_streams: int, k: int, tile_width: int, dtype,
+                 cap: int = 64) -> None:
+        if cap <= 0:
+            raise ValueError(f"gate_tile must be positive, got {cap}")
+        self._S = int(num_streams)
+        self._k = int(k)
+        self._B = int(tile_width)
+        self._cap = int(cap)
+        self._dtype = np.dtype(dtype)
+        self._dirty = True
+        self._seen_resets = -1
+        # candidate coalescing buffers: gtile rows fill left-to-right
+        # across flushes; gadv counts TOTAL logical elements consumed per
+        # row since the last gated dispatch (int64 internally; a dispatch
+        # is forced long before the int32 wire format could wrap)
+        self._gtile = np.zeros((self._S, self._cap), self._dtype)
+        self._gcount = np.zeros(self._S, np.int64)
+        self._gadv = np.zeros(self._S, np.int64)
+        self._cols = np.arange(self._cap, dtype=np.int32)[None, :]
+        self._rows = np.arange(self._S, dtype=np.int32)[:, None]
+        key = (self._k, self._cap)
+        fn = _EVAL_CACHE.get(key)
+        if fn is None:
+            fn = _EVAL_CACHE[key] = _build_eval(self._k, self._cap)
+        self._eval_fn = fn
+        self._count = self._nxt = self._logw = None
+        self._k1 = self._k2 = None
+
+    # ------------------------------------------------------------ properties
+
+    @property
+    def cap(self) -> int:
+        """Gate-tile width: max candidates bufferable per row."""
+        return self._cap
+
+    def pending(self) -> bool:
+        """Whether any consumed-but-undispatched advance is buffered."""
+        return bool(self._gadv.any())
+
+    def advance_high(self) -> bool:
+        """Buffered advance nearing the int32 wire format — force a
+        dispatch (unreachable in practice: 2^30 elements per row between
+        dispatches)."""
+        return bool(self._gadv.max(initial=0) >= (1 << 30))
+
+    # --------------------------------------------------------------- replica
+
+    def stale(self, engine) -> bool:
+        """True when the replica no longer mirrors the engine (never
+        synced, or rows were reset behind the gate's back)."""
+        return self._dirty or engine.reset_epochs != self._seen_resets
+
+    def mark_dirty(self) -> None:
+        """The engine was mutated outside the gated flush path
+        (``push_tile``, recovery replay): re-pull before the next eval."""
+        self._dirty = True
+
+    def resync(self, engine) -> None:
+        """Re-pull ``(count, nxt, log_w, key)`` from the live engine state.
+
+        The caller must hold the engine's single-writer slot (the bridge
+        drains its pipeline first) and must have dispatched any pending
+        gated buffer — buffered candidates predate the state being pulled.
+        """
+        import jax
+        import jax.random as jr
+
+        if self.pending():
+            raise RuntimeError(
+                "resync with a pending gated buffer would reorder the "
+                "stream; dispatch it first"
+            )
+        state = engine._state
+        cpu = jax.devices("cpu")[0]
+        kd = np.asarray(jr.key_data(state.key))
+        stage = {
+            "count": np.asarray(state.count),
+            "nxt": np.asarray(state.nxt),
+            "logw": np.asarray(state.log_w),
+            "k1": np.ascontiguousarray(kd[..., 0]),
+            "k2": np.ascontiguousarray(kd[..., 1]),
+        }
+        placed = jax.device_put(stage, cpu)
+        self._count, self._nxt, self._logw = (
+            placed["count"], placed["nxt"], placed["logw"]
+        )
+        self._k1, self._k2 = placed["k1"], placed["k2"]
+        self._seen_resets = engine.reset_epochs
+        self._dirty = False
+
+    def evaluate(self, valid: np.ndarray) -> GateEval:
+        """Run the skip recursion over one chunk of ``valid[r]`` elements
+        per row (one vmapped call); returns the candidate verdict WITHOUT
+        committing — pair with :meth:`commit` on the path that actually
+        consumes the chunk at this granularity.  Rows with ``valid[r] ==
+        0`` are untouched."""
+        import jax
+
+        cpu = jax.devices("cpu")[0]
+        with jax.default_device(cpu):
+            m = jax.device_put(np.ascontiguousarray(valid, np.int32), cpu)
+            pos, f, n_acc, count, nxt, logw = self._eval_fn(
+                self._count, self._nxt, self._logw, self._k1, self._k2, m
+            )
+        pos = np.asarray(pos)
+        f = np.asarray(f)
+        n_acc = np.asarray(n_acc)
+        n_cand = f + n_acc
+        return GateEval(
+            pos, f, n_acc, n_cand, bool((n_cand > self._cap).any()),
+            (count, nxt, logw),
+        )
+
+    def evaluate_row(self, row: int, m: int) -> GateEval:
+        """:meth:`evaluate` for a single row's contiguous chunk of ``m``
+        elements — the pre-staging push fast path: a row-major producer's
+        chunk is gated BEFORE any demux/staging copy ever happens."""
+        valid = np.zeros(self._S, np.int32)
+        valid[row] = m
+        return self.evaluate(valid)
+
+    def commit(self, ev: GateEval) -> None:
+        """Adopt the post-chunk replica state: the evaluated chunk is now
+        CONSUMED (buffered gated, dispatched gated, or shipped whole as an
+        ungated fallback — every path runs the same recursion device-side)."""
+        self._count, self._nxt, self._logw = ev.state
+
+    # --------------------------------------------------------------- buffers
+
+    def fits(self, ev: GateEval) -> bool:
+        """Whether this eval's candidates fit the remaining buffer room."""
+        return bool(((self._gcount + ev.n_cand) <= self._cap).all())
+
+    def fits_row(self, row: int, ev: GateEval) -> bool:
+        return bool(self._gcount[row] + ev.n_cand[row] <= self._cap)
+
+    def append_row(self, row: int, chunk: np.ndarray, ev: GateEval) -> int:
+        """Gather one row-chunk's candidates straight from the producer's
+        array (no staging copy); returns the elided element count.
+        Caller guarantees ``fits_row`` and ``ev.n_cand[row] <= cap``."""
+        f = int(ev.fill[row])
+        na = int(ev.n_acc[row])
+        nc = f + na
+        if nc:
+            idx = np.concatenate(
+                [np.arange(f, dtype=np.int64), ev.pos[row, :na]]
+            ) if f else ev.pos[row, :na]
+            at = int(self._gcount[row])
+            self._gtile[row, at:at + nc] = chunk[idx]
+            self._gcount[row] += nc
+        self._gadv[row] += chunk.size
+        return int(chunk.size) - nc
+
+    def append(self, tile: np.ndarray, valid: np.ndarray, ev: GateEval) -> int:
+        """Gather the candidates of ``tile`` into the coalescing buffer;
+        returns the number of ELIDED elements (staged minus candidates).
+        Caller guarantees ``fits(ev)`` and ``not ev.fallback``."""
+        n_cand = ev.n_cand
+        total_cand = int(n_cand.sum())
+        total = int(np.asarray(valid).sum())
+        if total_cand:
+            # gather index per (row, slot): fill prefix positions 0..f-1,
+            # then the accept positions — one vectorized fancy-gather
+            f = ev.fill[:, None]
+            acc_j = np.minimum(
+                np.maximum(self._cols - f, 0), self._cap - 1
+            )
+            gidx = np.where(self._cols < f, self._cols, ev.pos[self._rows, acc_j])
+            mask = self._cols < n_cand[:, None]
+            vals = np.take_along_axis(
+                tile, np.clip(gidx, 0, self._B - 1), axis=1
+            )
+            rsel, csel = np.nonzero(mask)
+            self._gtile[rsel, self._gcount[rsel] + csel] = vals[rsel, csel]
+            self._gcount += n_cand
+        self._gadv += np.asarray(valid, np.int64)
+        return total - total_cand
+
+    def take(self):
+        """Snapshot-and-reset the coalescing buffer for dispatch: returns
+        ``(gtile, nvalid, advance, total_advance)`` as fresh arrays (safe
+        to hand to the flush pipeline and the journal)."""
+        gtile = self._gtile.copy()
+        nvalid = self._gcount.astype(np.int32)
+        advance = self._gadv.astype(np.int32)
+        total_adv = int(self._gadv.sum())
+        self._gcount[:] = 0
+        self._gadv[:] = 0
+        return gtile, nvalid, advance, total_adv

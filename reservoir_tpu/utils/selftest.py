@@ -167,6 +167,39 @@ def _check_weighted(interpret: bool) -> bool:
     return _leaves_equal(ref, got)
 
 
+def _check_gated(interpret: bool) -> bool:
+    """Gated-vs-ungated bridge bit-parity on the live backend (ISSUE 8).
+
+    The ingest-side skip gate's host replica runs jitted on the CPU
+    backend while the engine runs on whatever backend serves — on CPU the
+    two are the same compiled math (bit-identical by construction, the
+    tier-1 pin); on TPU this check is the OPEN question the capture rows
+    exist to answer: do the host-CPU and TPU transcendentals agree to the
+    last ulp across a real stream?  The result rides the ``parity_probe``
+    selftest JSON as ``gated_parity`` — a pinned capture row instead of
+    the r04-era null."""
+    import numpy as np
+
+    from ..config import SamplerConfig
+    from ..stream.bridge import DeviceStreamBridge
+
+    S, k, B = (8, 8, 64) if interpret else (64, 16, 256)
+    rounds = 8
+    rng = np.random.default_rng(12)
+    data = rng.integers(0, 1 << 30, (S, rounds * B)).astype(np.int32)
+    results = []
+    for gated in (False, True):
+        cfg = SamplerConfig(max_sample_size=k, num_reservoirs=S, tile_size=B)
+        bridge = DeviceStreamBridge(cfg, key=5, gated=gated, gate_tile=32)
+        for s in range(S):
+            bridge.push(s, data[s])
+        results.append(bridge.complete())
+    return all(
+        np.array_equal(np.asarray(a), np.asarray(b))
+        for a, b in zip(results[0], results[1])
+    )
+
+
 def _check_ks(interpret: bool):
     """On-backend statistical-quality gate: pooled one-sample KS of the
     device sampler's output against the exact uniform law, at the literal
@@ -260,7 +293,7 @@ def device_selftest(emit_partial=None) -> Dict[str, Any]:
 
     Returns ``{"platform": ..., "algl": bool, "algl_fill": bool,
     "distinct": bool, "weighted": bool, "pallas_parity": bool,
-    "ks_ok": bool, ["ks_uniform": float],
+    "gated_parity": bool, "ks_ok": bool, ["ks_uniform": float],
     "ks_distinct_ok": bool, ["ks_distinct": float],
     "ks_weighted_ok": bool, ["ks_weighted": float],
     ["<name>_error": str], ["ks*_error": str]}`` — never raises; a crash
@@ -304,6 +337,15 @@ def device_selftest(emit_partial=None) -> Dict[str, Any]:
             out[f"{name}_error"] = f"{type(e).__name__}: {e}"[:500]
         ok = ok and out[name]
     out["pallas_parity"] = ok
+    _stage_done()
+    # gated-vs-ungated bridge parity (ISSUE 8): separate key — on TPU it
+    # additionally crosses host-CPU-vs-device transcendentals, and that
+    # empirical answer must not erase the Pallas bit-parity evidence
+    try:
+        out["gated_parity"] = bool(_check_gated(interpret))
+    except Exception as e:
+        out["gated_parity"] = False
+        out["gated_parity_error"] = f"{type(e).__name__}: {e}"[:500]
     _stage_done()
     try:
         out["ks_uniform"], out["ks_ok"] = _check_ks(interpret)

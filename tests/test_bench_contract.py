@@ -100,6 +100,36 @@ def test_bench_traffic_row_reports_wait_staleness_and_slo_verdicts():
     assert "loadgen.wait_s" in stages["telemetry"]
 
 
+def test_bench_gated_row_reports_ab_and_skip_fraction():
+    # the ISSUE-8 acceptance surface: `bench.py gated` must run the
+    # gated-vs-ungated A/B end-to-end on CPU with bit-identity asserted
+    # in-run, and its row must carry effective elem/s for BOTH sides,
+    # the speedup, the skip fraction, and bytes-shipped-per-element —
+    # the stable column names watcher captures parse.  One rep: the row
+    # contract is shape, not statistics — keep the tier-1 budget lean
+    rec = _run_bench(
+        {"RESERVOIR_BENCH_CONFIG": "gated", "RESERVOIR_BENCH_REPS": "1"}
+    )
+    assert "gated_bridge_feed" in rec["metric"]
+    assert rec["value"] > 0
+    assert rec["speedup"] > 0
+    assert 0.0 <= rec["skip_frac"] <= 1.0
+    stages = rec["stages"]
+    for col in (
+        "gate_tile", "n_over_k", "ungated_elem_per_s", "gated_elem_per_s",
+        "speedup", "skip_frac", "bytes_per_elem_shipped",
+        "bytes_per_elem_raw", "gated_dispatches", "gate_buffered_flushes",
+        "gate_eval_s", "flushes_gated", "flushes_ungated", "bit_identical",
+    ):
+        assert col in stages, col
+    # the row only exists if the gated reservoirs matched bit-for-bit
+    assert stages["bit_identical"] is True
+    # the gate must actually have elided bytes and coalesced dispatches
+    assert stages["skip_frac"] > 0.5
+    assert stages["flushes_gated"] < stages["flushes_ungated"]
+    assert stages["bytes_per_elem_shipped"] < stages["bytes_per_elem_raw"]
+
+
 def test_bench_rejects_unknown_config():
     env = dict(os.environ)
     env.update(RESERVOIR_BENCH_SMOKE="1", RESERVOIR_BENCH_CONFIG="nope")

@@ -1,0 +1,581 @@
+"""Ingest-side skip-ahead gating (ISSUE 8): the bit-reconciliation matrix.
+
+The gate's entire license is this file: a gated
+:class:`DeviceStreamBridge` must produce reservoirs **bit-identical** to
+the ungated path — same Threefry blocks consumed per logical index, same
+accepted set — across sampling modes, chunk geometries, the pre-staging
+push fast path, crash-recovery journal replay, hot-standby tailing, and
+the serving plane (including row recycling and the 10k-session soak).
+Everything else (skip fractions, coalesced dispatches, elided bytes) is
+only interesting because these tests hold.
+"""
+
+from __future__ import annotations
+
+import gc
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+import jax.random as jr
+
+from reservoir_tpu import SamplerConfig
+from reservoir_tpu.engine import ReservoirEngine
+from reservoir_tpu.errors import SamplerClosedError, ServiceSaturated
+from reservoir_tpu.ops import algorithm_l as al
+from reservoir_tpu.serve import ReservoirService, StandbyReplica
+from reservoir_tpu.stream.bridge import DeviceStreamBridge, _FlushJournal
+from reservoir_tpu.stream.gate import SkipGate, gate_ineligible_reason
+from reservoir_tpu.utils.faults import FaultPlane, FaultRule
+
+
+def _cfg(mode="plain", **kw):
+    kw.setdefault("max_sample_size", 8)
+    kw.setdefault("num_reservoirs", 4)
+    kw.setdefault("tile_size", 32)
+    return SamplerConfig(
+        distinct=(mode == "distinct"), weighted=(mode == "weighted"), **kw
+    )
+
+
+def _feed(bridge, data, wdata=None, chunk=None):
+    """Push every row's stream in ``chunk``-sized pieces (whole row when
+    None), then complete."""
+    S, N = data.shape
+    step = N if chunk is None else chunk
+    for off in range(0, N, step):
+        for s in range(S):
+            if wdata is not None:
+                bridge.push(s, data[s, off:off + step],
+                            weights=wdata[s, off:off + step])
+            else:
+                bridge.push(s, data[s, off:off + step])
+    return bridge.complete()
+
+
+def _equal(a_list, b_list):
+    return all(
+        np.array_equal(np.asarray(a), np.asarray(b))
+        for a, b in zip(a_list, b_list)
+    )
+
+
+# -------------------------------------------------------- replica oracle
+
+
+def test_gate_replica_chain_is_bit_identical_to_engine_updates():
+    """The heart of the design: the host replica runs the SAME compiled
+    skip recursion as the engine's accept loop, so (count, nxt, log_w)
+    match bit-for-bit over any ragged tiling — floats compared by bit
+    pattern, not tolerance."""
+    S, k, B = 5, 8, 16
+    state = al.init(jr.key(3), S, k)
+    gate = SkipGate(S, k, B, np.int32, cap=64)
+
+    class _Eng:  # minimal engine stand-in for resync()
+        reset_epochs = 0
+        _state = state
+
+    gate.resync(_Eng)
+    rng = np.random.default_rng(0)
+    upd = jax.jit(al.update)
+    for _ in range(150):
+        m = rng.integers(0, B + 1, S).astype(np.int32)
+        batch = jnp.asarray(rng.integers(0, 1 << 30, (S, B)).astype(np.int32))
+        state = upd(state, batch, valid=jnp.asarray(m))
+        ev = gate.evaluate(m)
+        gate.commit(ev)
+    count, nxt, logw = gate._count, gate._nxt, gate._logw
+    np.testing.assert_array_equal(np.asarray(state.count), np.asarray(count))
+    np.testing.assert_array_equal(np.asarray(state.nxt), np.asarray(nxt))
+    np.testing.assert_array_equal(
+        np.asarray(state.log_w).view(np.int32),
+        np.asarray(logw).view(np.int32),
+    )
+
+
+# --------------------------------------------- gated == ungated (modes)
+
+
+@pytest.mark.parametrize("mode", ["plain", "weighted", "distinct"])
+def test_bit_reconciliation_gated_vs_ungated_across_modes(mode):
+    """The matrix row: gated and ungated bridges over the same feed
+    produce identical reservoirs in all three modes.  In weighted and
+    distinct modes the gate is INERT by design (and says why); in plain
+    mode it elides and the results still match bit-for-bit."""
+    S, B, rounds = 4, 32, 6
+    cfg = _cfg(mode)
+    rng = np.random.default_rng(7)
+    data = rng.integers(0, 1 << 30, (S, rounds * B)).astype(np.int32)
+    if mode == "distinct":
+        data = (data % 97).astype(np.int32)
+    wdata = (
+        rng.uniform(0.1, 2.0, data.shape).astype(np.float32)
+        if mode == "weighted"
+        else None
+    )
+    results, gate_states = [], []
+    for gated in (False, True):
+        bridge = DeviceStreamBridge(cfg, key=7, gated=gated, gate_tile=16)
+        gate_states.append((bridge.gate_active, bridge.gate_inert_reason))
+        results.append(_feed(bridge, data, wdata, chunk=B))
+    assert _equal(results[0], results[1])
+    assert gate_states[0] == (False, None)  # never requested
+    if mode == "plain":
+        assert gate_states[1] == (True, None)
+    else:
+        active, reason = gate_states[1]
+        assert not active and reason  # inert, with a stated reason
+
+
+def test_bit_reconciliation_across_chunk_boundary_splits():
+    """Tile-split invariance survives the gate: any chunking of the same
+    per-row streams — single elements, primes, tile-straddling chunks,
+    one bulk push (the pre-staging fast path) — lands bit-identical to
+    the ungated reference."""
+    S, B, rounds = 3, 16, 12
+    cfg = _cfg(num_reservoirs=S, tile_size=B, max_sample_size=6)
+    rng = np.random.default_rng(11)
+    data = rng.integers(0, 1 << 30, (S, rounds * B)).astype(np.int32)
+    ref = _feed(DeviceStreamBridge(cfg, key=3), data, chunk=B)
+    # element-at-a-time and off-by-one widths ride the fuzz test; here the
+    # structural boundaries: a prime stride, the exact tile, a straddling
+    # stride, and one bulk push (the pre-staging fast path)
+    for chunk in (7, B, 3 * B + 5, None):
+        bridge = DeviceStreamBridge(cfg, key=3, gated=True, gate_tile=12)
+        got = _feed(bridge, data, chunk=chunk)
+        assert _equal(ref, got), f"chunk={chunk}"
+
+
+def test_gated_interleaved_feed_matches_ungated():
+    """The staged gate path specifically (``_gate_flush``): an
+    interleaved multi-producer feed demuxes into staging, the gate
+    evaluates per flushed tile, and results still match bit-for-bit."""
+    S, B, rounds = 4, 16, 6
+    cfg = _cfg(num_reservoirs=S, tile_size=B, max_sample_size=4)
+    rng = np.random.default_rng(5)
+    data = rng.integers(0, 1 << 30, (S, rounds * B)).astype(np.int32)
+    streams = np.tile(np.arange(S, dtype=np.int32), B)
+    results = []
+    for gated in (False, True):
+        bridge = DeviceStreamBridge(cfg, key=9, gated=gated, gate_tile=8)
+        for t in range(rounds):
+            bridge.push_interleaved(
+                streams,
+                np.ascontiguousarray(data[:, t * B:(t + 1) * B].T.ravel()),
+            )
+        results.append(bridge.complete())
+        if gated:
+            m = bridge.metrics
+            assert m.gate_bytes_elided > 0  # the gate really elided
+            assert m.gated_dispatches >= 1
+    assert _equal(results[0], results[1])
+
+
+def test_gated_fill_overflow_falls_back_and_steady_state_elides():
+    """k larger than the gate tile: every fill-phase chunk overflows the
+    candidate buffer and takes the ungated fallback, steady-state chunks
+    elide — and the whole life cycle stays bit-identical."""
+    S, B, rounds, k = 3, 16, 20, 24  # k > gate_tile=8, fill spans tiles
+    cfg = _cfg(num_reservoirs=S, tile_size=B, max_sample_size=k)
+    rng = np.random.default_rng(13)
+    data = rng.integers(0, 1 << 30, (S, rounds * B)).astype(np.int32)
+    ref = _feed(DeviceStreamBridge(cfg, key=1), data, chunk=B)
+    bridge = DeviceStreamBridge(cfg, key=1, gated=True, gate_tile=8)
+    got = _feed(bridge, data, chunk=B)
+    assert _equal(ref, got)
+    m = bridge.metrics
+    assert m.gate_bytes_shipped > 0  # fallback tiles were counted shipped
+    assert m.gate_bytes_elided > 0  # and the steady tail elided
+    assert m.gated_dispatches >= 1
+
+
+def test_gated_with_map_fn_matches_ungated():
+    cfg = _cfg(num_reservoirs=3, tile_size=16, max_sample_size=4)
+    rng = np.random.default_rng(17)
+    data = rng.integers(0, 1 << 20, (3, 160)).astype(np.int32)
+    map_fn = lambda x: x * 2 + 1  # noqa: E731 - traceable map hook
+    ref = _feed(
+        DeviceStreamBridge(cfg, key=2, map_fn=map_fn), data, chunk=16
+    )
+    got = _feed(
+        DeviceStreamBridge(cfg, key=2, map_fn=map_fn, gated=True,
+                           gate_tile=8),
+        data, chunk=16,
+    )
+    assert _equal(ref, got)
+
+
+@pytest.mark.parametrize("dtype", ["int8", "bfloat16"])
+def test_gated_payload_compaction_with_narrow_dtypes(dtype):
+    """Payload compaction rides the ``_native`` staging path at narrow
+    element widths (ISSUE 8 satellite): int8/bf16 gated bridges stay
+    bit-identical to ungated and the gated frames ship proportionally
+    fewer bytes per element."""
+    np_dtype = np.dtype(jnp.bfloat16) if dtype == "bfloat16" else np.dtype(
+        dtype
+    )
+    cfg = SamplerConfig(
+        max_sample_size=8, num_reservoirs=4, tile_size=32,
+        element_dtype=dtype,
+    )
+    rng = np.random.default_rng(3)
+    if dtype == "int8":
+        data = rng.integers(-128, 128, (4, 320)).astype(np_dtype)
+    else:
+        data = rng.standard_normal((4, 320)).astype(np_dtype)
+    results = []
+    for gated in (False, True):
+        bridge = DeviceStreamBridge(cfg, key=2, gated=gated, gate_tile=16)
+        for s in range(4):
+            bridge.push(s, data[s])
+        results.append(bridge.complete())
+        if gated:
+            m = bridge.metrics
+            assert m.gate_bytes_elided > 0
+            # shipped bytes scale with the narrow itemsize, not int32's
+            assert m.gate_bytes_shipped < data.size * 4
+    assert _equal(results[0], results[1])
+
+
+# ----------------------------------------------------- journal + recovery
+
+
+def test_gated_kill_midstream_recover_replays_bit_exact(tmp_path):
+    """The matrix's crash row: an injected fatal fault kills a gated
+    journaling bridge mid-stream; ``recover()`` replays the mixed
+    plain/gated journal and the producer resumes from the per-row durable
+    counts — final reservoirs bit-identical to an uninterrupted run."""
+    S, B, rounds = 3, 16, 12
+    cfg = _cfg(num_reservoirs=S, tile_size=B, max_sample_size=4)
+    rng = np.random.default_rng(19)
+    data = rng.integers(0, 1 << 30, (S, rounds * B)).astype(np.int32)
+    expected = _feed(
+        DeviceStreamBridge(cfg, key=11, gated=True, gate_tile=8),
+        data, chunk=B,
+    )
+
+    plane = FaultPlane(
+        [FaultRule("bridge.dispatch", exc=RuntimeError, after=2, times=1,
+                   message="injected kill")]
+    )
+    ckdir = str(tmp_path / "ck")
+    bridge = DeviceStreamBridge(
+        cfg, key=11, gated=True, gate_tile=8,
+        checkpoint_dir=ckdir, checkpoint_every=3, faults=plane,
+    )
+    killed = False
+    try:
+        _feed(bridge, data, chunk=B)
+    except (RuntimeError, SamplerClosedError):
+        killed = True
+    assert killed, "the injected fault must kill the stream mid-feed"
+    del bridge
+    gc.collect()
+
+    recovered = DeviceStreamBridge.recover(ckdir)
+    assert recovered.gate_active  # gating survives recovery (metadata)
+    # the gated resume contract: per-row durable counts ARE the watermark
+    counts = np.asarray(recovered.engine._state.count)
+    for s in range(S):
+        rem = data[s, counts[s]:]
+        if rem.size:
+            recovered.push(s, rem)
+    got = recovered.complete()
+    assert _equal(expected, got)
+
+
+def test_journal_mixed_gated_frames_roundtrip_and_torn_tail(tmp_path):
+    """Journal format row: plain and gated frames interleave in one file,
+    ``read_records`` types them apart (``advance`` non-None marks gated,
+    with Bg recovered from the frame length), and a torn gated tail is
+    tolerated exactly like a torn plain one."""
+    import os
+
+    path = str(tmp_path / "journal.bin")
+    S, B, bg = 2, 8, 4
+    journal = _FlushJournal(path, S, B, np.int32, weighted=False)
+    tile = np.arange(S * B, dtype=np.int32).reshape(S, B)
+    valid = np.full(S, B, np.int32)
+    gtile = np.arange(S * bg, dtype=np.int32).reshape(S, bg)
+    nvalid = np.asarray([2, 0], np.int32)
+    advance = np.asarray([17, 40], np.int32)
+    journal.append(1, tile, valid, None)
+    journal.append_gated(2, gtile, nvalid, advance)
+    journal.append(3, tile + 5, valid, None)
+    journal.close()
+
+    recs = list(_FlushJournal.replay(path, S, B, np.int32, False))
+    assert [r[0] for r in recs] == [1, 2, 3]
+    assert recs[0][4] is None and recs[2][4] is None
+    np.testing.assert_array_equal(recs[1][1], gtile)  # Bg=4 recovered
+    np.testing.assert_array_equal(recs[1][2], nvalid)
+    np.testing.assert_array_equal(recs[1][4], advance)
+
+    # torn tail: truncate mid-last-record -> exactly the intact prefix
+    full = os.path.getsize(path)
+    with open(path, "r+b") as fh:
+        fh.truncate(full - 3)
+    recs = list(_FlushJournal.replay(path, S, B, np.int32, False))
+    assert [r[0] for r in recs] == [1, 2]
+    # and a truncation into the GATED frame stops before it
+    plain_frame = _FlushJournal._HEADER.size + S * 4 + S * B * 4 + 4
+    with open(path, "r+b") as fh:
+        fh.truncate(plain_frame + 10)  # inside the gated frame
+    recs = list(_FlushJournal.replay(path, S, B, np.int32, False))
+    assert [r[0] for r in recs] == [1]
+
+
+def test_standby_replica_follows_gated_primary_bit_exactly(tmp_path):
+    """HA composition: a hot standby tails a GATED primary's journal —
+    mixed plain/gated frames apply through the same engine paths — and
+    its snapshots equal the primary's at the applied watermark."""
+    cfg = SamplerConfig(max_sample_size=4, num_reservoirs=8, tile_size=32)
+    ck = str(tmp_path / "ck")
+    svc = ReservoirService(
+        cfg, key=9, checkpoint_dir=ck, checkpoint_every=1 << 30,
+        coalesce_bytes=1 << 20, gated=True, gate_tile=16,
+    )
+    for i in range(8):
+        svc.open_session(f"u{i}")
+    svc.sync()
+    standby = StandbyReplica(ck)
+    rng = np.random.default_rng(2)
+    for _ in range(6):
+        for i in range(8):
+            svc.ingest(f"u{i}", rng.integers(0, 1 << 30, 24).astype(np.int32))
+        svc.sync()
+        standby.poll()
+    assert standby.lag()[0] == 0
+    for key in ("u1", "u5"):
+        np.testing.assert_array_equal(
+            standby.snapshot(key), svc.snapshot(key), err_msg=key
+        )
+
+
+# ---------------------------------------------------------- serving plane
+
+
+def test_gated_service_matches_ungated_including_recycling(tmp_path):
+    """Serve composition: gated and ungated services run the same session
+    script — ingest, close, a recycled-row reopen (reset_rows resyncs the
+    gate replica via reset_epochs), a crash + recover — and every
+    snapshot matches bit-for-bit."""
+    cfg = SamplerConfig(max_sample_size=4, num_reservoirs=8, tile_size=16)
+    rng = np.random.default_rng(23)
+    script = [rng.integers(0, 1 << 30, 24).astype(np.int32)
+              for _ in range(40)]
+
+    def run(gated, ckdir):
+        svc = ReservoirService(
+            cfg, key=5, gated=gated, gate_tile=8,
+            checkpoint_dir=ckdir, checkpoint_every=4,
+        )
+        for i in range(8):
+            svc.open_session(f"u{i}")
+        it = iter(script)
+        for _ in range(3):
+            for i in range(8):
+                svc.ingest(f"u{i}", next(it))
+        svc.close_session("u0")
+        svc.open_session("v0")  # recycled row: generation 1 + reset
+        for _ in range(2):
+            svc.ingest("v0", next(it))
+        svc.sync()
+        snaps = {k_: svc.snapshot(k_) for k_ in ("u3", "u7", "v0")}
+        del svc
+        gc.collect()
+        rec = ReservoirService.recover(ckdir)
+        rec_snaps = {k_: rec.snapshot(k_) for k_ in ("u3", "u7", "v0")}
+        return snaps, rec_snaps
+
+    snaps_u, rec_u = run(False, str(tmp_path / "u"))
+    snaps_g, rec_g = run(True, str(tmp_path / "g"))
+    for k_ in snaps_u:
+        np.testing.assert_array_equal(snaps_u[k_], snaps_g[k_], err_msg=k_)
+        np.testing.assert_array_equal(rec_u[k_], rec_g[k_], err_msg=k_)
+        np.testing.assert_array_equal(snaps_u[k_], rec_g[k_], err_msg=k_)
+
+
+def test_gated_soak_10k_sessions_snapshots_match_ungated():
+    """The matrix's scale row: a >= 10k-session serve soak with the gate
+    on — every probed snapshot bit-identical to the ungated service over
+    the same traffic (``RESERVOIR_SERVE_SOAK_SESSIONS`` scales it; the
+    watcher's ``gated_rehearsal`` post-step runs it on hardware)."""
+    import os
+
+    S = int(os.environ.get("RESERVOIR_SERVE_SOAK_SESSIONS", "10240"))
+    k, B, per = 2, 8, 6
+    cfg = SamplerConfig(max_sample_size=k, num_reservoirs=S, tile_size=B)
+    rng = np.random.default_rng(7)
+    chunks = rng.integers(0, 1000, (S, per)).astype(np.int32)
+
+    def run(gated):
+        svc = ReservoirService(
+            cfg, key=77, coalesce_bytes=1 << 18, gated=gated
+        )
+        for i in range(S):
+            svc.open_session(f"u{i}")
+        for i in range(S):
+            svc.ingest(f"u{i}", i * 1000 + chunks[i])
+        svc.sync()
+        probe = [f"u{i}" for i in rng.integers(0, S, 16)]
+        snaps = {key: svc.snapshot(key) for key in dict.fromkeys(probe)}
+        return snaps, svc
+
+    rng = np.random.default_rng(7)  # same probe draws for both runs
+    chunks = rng.integers(0, 1000, (S, per)).astype(np.int32)
+    snaps_u, _ = run(False)
+    rng = np.random.default_rng(7)
+    chunks = rng.integers(0, 1000, (S, per)).astype(np.int32)
+    snaps_g, svc_g = run(True)
+    assert snaps_u.keys() == snaps_g.keys()
+    for key in snaps_u:
+        np.testing.assert_array_equal(snaps_u[key], snaps_g[key], err_msg=key)
+    assert svc_g.bridge.gate_active
+
+
+def test_gated_fuzz_random_feeds_and_geometry():
+    """Randomized reconciliation fuzz: arbitrary interleavings of partial
+    pushes, spontaneous flush barriers, ragged tails, random gate tiles
+    (including cap < k, which forces permanent fill fallback) — every
+    trial must land bit-identical to the ungated reference."""
+    rng = np.random.default_rng(42)
+    for trial in range(1):
+        S = int(rng.integers(2, 6))
+        B = int(rng.integers(8, 40))
+        k = int(rng.integers(2, 12))
+        cap = int(rng.integers(4, 24))
+        rounds = int(rng.integers(5, 12))
+        cfg = SamplerConfig(
+            max_sample_size=k, num_reservoirs=S, tile_size=B
+        )
+        data = {
+            s: rng.integers(
+                0, 1 << 30, rounds * B + int(rng.integers(0, B))
+            ).astype(np.int32)
+            for s in range(S)
+        }
+
+        def feed(bridge):
+            offs = {s: 0 for s in range(S)}
+            seed2 = np.random.default_rng(trial)
+            while any(offs[s] < len(data[s]) for s in range(S)):
+                s = int(seed2.integers(0, S))
+                n = int(seed2.integers(1, 3 * B))
+                chunk = data[s][offs[s]:offs[s] + n]
+                if chunk.size == 0:
+                    continue
+                bridge.push(s, chunk)
+                offs[s] += chunk.size
+                if seed2.random() < 0.1:
+                    bridge.flush()
+            return bridge.complete()
+
+        ref = feed(DeviceStreamBridge(cfg, key=trial))
+        got = feed(
+            DeviceStreamBridge(
+                cfg, key=trial, gated=True, gate_tile=cap,
+                gate_push_chunk=int(rng.integers(8, 200)),
+            )
+        )
+        assert _equal(ref, got), f"trial {trial} S={S} B={B} k={k} cap={cap}"
+
+
+# ------------------------------------------- pre-gate admission semantics
+
+
+def test_admission_accounting_counts_pre_gate_bytes(tmp_path):
+    """The ISSUE-8 'small fix' pin: enabling the gate must not change
+    what admission control, ``flush_would_block`` or the bridge element
+    counters MEAN.  ``elements``/``flushed_elements`` count pre-gate
+    logical elements (not shipped candidate bytes), and the saturation
+    rejection fires at the same pre-gate pending-byte threshold with a
+    positive retry hint, gated or not."""
+    S, B, rounds = 2, 16, 8
+    cfg = _cfg(num_reservoirs=S, tile_size=B, max_sample_size=4)
+    rng = np.random.default_rng(29)
+    data = rng.integers(0, 1 << 30, (S, rounds * B)).astype(np.int32)
+    bridge = DeviceStreamBridge(cfg, key=0, gated=True, gate_tile=8)
+    _feed(bridge, data, chunk=B)
+    m = bridge.metrics
+    # pre-gate accounting: every pushed element is counted, and once the
+    # completion barrier forced the final dispatch, every one is flushed
+    assert m.elements == data.size
+    assert m.flushed_elements == data.size
+    assert not bridge.flush_would_block()  # idle pipeline, gate pending or not
+
+    def reject_point(gated):
+        plane = FaultPlane(
+            [FaultRule("bridge.dispatch", exc=None, delay=0.5, times=1)]
+        )
+        svc = ReservoirService(
+            SamplerConfig(max_sample_size=4, num_reservoirs=2, tile_size=4),
+            key=0,
+            faults=plane,
+            coalesce_bytes=16,
+            max_inflight_bytes=64,
+            gated=gated,
+        )
+        svc.open_session("a")
+        svc.ingest("a", np.arange(4, dtype=np.int32))
+        with pytest.raises(ServiceSaturated) as exc_info:
+            for i in range(9):
+                svc.ingest("a", np.arange(8, dtype=np.int32))
+        assert exc_info.value.retry_after_s > 0
+        return i, svc.metrics.rejections
+
+    # the rejection fires at the same ingest index with the gate on: the
+    # admission bound watches PRE-gate pending bytes, not shipped bytes
+    assert reject_point(False) == reject_point(True)
+
+
+# ------------------------------------------------------------- validation
+
+
+def test_gate_eligibility_matrix():
+    assert gate_ineligible_reason(_cfg("plain")) is None
+    assert "weighted" in gate_ineligible_reason(_cfg("weighted"))
+    assert "distinct" in gate_ineligible_reason(_cfg("distinct"))
+    assert "WIDE" in gate_ineligible_reason(
+        _cfg("plain", count_dtype="wide")
+    )
+    assert "mesh" in gate_ineligible_reason(_cfg("plain", mesh_axis="r"))
+
+
+def test_sample_gated_validations():
+    eng = ReservoirEngine(_cfg("plain", num_reservoirs=2), key=0,
+                          reusable=True)
+    tile = np.zeros((2, 4), np.int32)
+    with pytest.raises(ValueError, match="nvalid"):
+        eng.sample_gated(tile, [5, 0], [8, 8])  # nvalid > Bg
+    with pytest.raises(ValueError, match="nonnegative"):
+        eng.sample_gated(tile, [0, 0], [-1, 0])
+    weng = ReservoirEngine(_cfg("weighted", num_reservoirs=2), key=0,
+                           reusable=True)
+    with pytest.raises(ValueError, match="duplicates mode"):
+        weng.sample_gated(tile, [0, 0], [0, 0])
+    wide = ReservoirEngine(
+        _cfg("plain", num_reservoirs=2, count_dtype="wide"), key=0,
+        reusable=True,
+    )
+    with pytest.raises(ValueError, match="narrow"):
+        wide.sample_gated(tile, [0, 0], [0, 0])
+    # update_gated itself refuses WIDE states
+    st = al.init(jr.key(0), 2, 4, count_dtype=al.WIDE)
+    with pytest.raises(ValueError, match="narrow"):
+        al.update_gated(st, jnp.zeros((2, 4), jnp.int32),
+                        jnp.zeros(2, jnp.int32), jnp.zeros(2, jnp.int32))
+
+
+def test_gate_resync_refuses_pending_buffer():
+    cfg = _cfg(num_reservoirs=2, tile_size=8, max_sample_size=2)
+    bridge = DeviceStreamBridge(cfg, key=0, gated=True, gate_tile=8)
+    # fill past the fill phase so a push buffers candidates
+    for s in range(2):
+        bridge.push(s, np.arange(64, dtype=np.int32))
+    assert bridge._gate.pending()
+    with pytest.raises(RuntimeError, match="pending"):
+        bridge._gate.resync(bridge.engine)

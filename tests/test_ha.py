@@ -93,7 +93,7 @@ def test_follower_tails_resumes_and_tolerates_torn_tail(tmp_path):
     follower = JournalFollower(path, S, B, np.int32, False)
     records, rotated, gap = follower.poll()
     assert [r[1] for r in records] == [1, 2] and not rotated and not gap
-    for end, seq, tile, valid, _ in records:
+    for end, seq, tile, valid, _, _adv in records:
         np.testing.assert_array_equal(tile, rec(seq)[0])
         follower.advance(seq, end)
     # caught up: a poll finds nothing, the cursor holds

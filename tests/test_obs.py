@@ -209,6 +209,18 @@ def test_disabled_telemetry_is_zero_overhead_noop(monkeypatch, tmp_path):
     for _ in range(3):
         bridge.push(0, np.arange(16, dtype=np.int32))
     bridge.complete()
+    # and the ingest-side skip gate (ISSUE 8): gated pushes, gate evals,
+    # candidate buffering, gated journal frames and gated dispatches must
+    # all short-circuit on the same module-global None check
+    gated = DeviceStreamBridge(
+        _cfg(), key=2, gated=True, gate_tile=8,
+        checkpoint_dir=str(tmp_path / "gated_ck"), checkpoint_every=1,
+    )
+    for _ in range(6):
+        gated.push(0, np.arange(16, dtype=np.int32))
+        gated.push(1, np.arange(16, dtype=np.int32))
+    gated.complete()
+    assert gated.metrics.gated_dispatches > 0  # the gate really ran
     # and the serving plane's ingest/snapshot/close paths — WITH the
     # sample-quality auditor attached (ISSUE 7): its hooks must also
     # short-circuit on the module-global None check, so a production

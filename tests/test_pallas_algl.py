@@ -15,11 +15,16 @@ import pytest
 from reservoir_tpu.ops import algorithm_l as al
 from reservoir_tpu.ops import algorithm_l_pallas as alp
 
+# jitted XLA references (see test_pallas_weighted._upd_w: the eager
+# path costs seconds per test on the single-core CI runner)
+_upd_a = jax.jit(al.update)
+_upd_a_steady = jax.jit(al.update_steady)
+
 
 def _fill(key, R, k, B, seed_elems=0):
     state = al.init(key, R, k)
     batch = seed_elems + jax.lax.broadcasted_iota(jnp.int32, (R, B), 1)
-    return al.update(state, batch), R * 0 + B
+    return _upd_a(state, batch), R * 0 + B
 
 
 def _assert_state_equal(a, b):
@@ -34,7 +39,7 @@ def test_pallas_matches_vmap_dense_accepts(R, k, B):
     # Right after fill: many acceptances per tile (stress the loop).
     state, _ = _fill(jr.key(0), R, k, B)
     batch = 10_000 + jax.lax.broadcasted_iota(jnp.int32, (R, B), 1)
-    ref = al.update_steady(state, batch)
+    ref = _upd_a_steady(state, batch)
     got = alp.update_steady_pallas(state, batch, block_r=8, interpret=True)
     _assert_state_equal(ref, got)
 
@@ -50,7 +55,7 @@ def test_pallas_matches_vmap_sparse_accepts():
         batch = s * B + jax.lax.broadcasted_iota(jnp.int32, (R, B), 1)
         state = step(state, batch)
     batch = 999_000 + jax.lax.broadcasted_iota(jnp.int32, (R, B), 1)
-    ref = al.update_steady(state, batch)
+    ref = _upd_a_steady(state, batch)
     got = alp.update_steady_pallas(state, batch, block_r=8, interpret=True)
     _assert_state_equal(ref, got)
 
@@ -62,7 +67,7 @@ def test_pallas_multi_tile_chain():
     s_ref = s_pal = state
     for s in range(6):
         batch = (100 + s) * B + jax.lax.broadcasted_iota(jnp.int32, (R, B), 1)
-        s_ref = al.update_steady(s_ref, batch)
+        s_ref = _upd_a_steady(s_ref, batch)
         s_pal = alp.update_steady_pallas(s_pal, batch, block_r=8, interpret=True)
         _assert_state_equal(s_ref, s_pal)
 
@@ -72,7 +77,7 @@ def test_pallas_multiblock_grid():
     R, k, B = 32, 8, 16
     state, _ = _fill(jr.key(3), R, k, B)
     batch = 7_777 + jax.lax.broadcasted_iota(jnp.int32, (R, B), 1)
-    ref = al.update_steady(state, batch)
+    ref = _upd_a_steady(state, batch)
     got = alp.update_steady_pallas(state, batch, block_r=8, interpret=True)
     _assert_state_equal(ref, got)
 
@@ -83,8 +88,8 @@ def test_pallas_float32_samples():
     R, k, B = 8, 8, 32
     state = al.init(jr.key(5), R, k, sample_dtype=jnp.float32)
     mk = lambda lo: lo + 0.5 + jax.lax.broadcasted_iota(jnp.float32, (R, B), 1)
-    state = al.update(state, mk(0.0))
-    ref = al.update_steady(state, mk(1000.0))
+    state = _upd_a(state, mk(0.0))
+    ref = _upd_a_steady(state, mk(1000.0))
     got = alp.update_steady_pallas(state, mk(1000.0), block_r=8, interpret=True)
     _assert_state_equal(ref, got)
 
@@ -95,8 +100,8 @@ def test_pallas_negative_zero_bit_pattern():
     R, k, B = 8, 8, 64
     state = al.init(jr.key(6), R, k, sample_dtype=jnp.float32)
     neg = jnp.full((R, B), -0.0, jnp.float32)
-    state = al.update(state, neg)
-    ref = al.update_steady(state, neg)
+    state = _upd_a(state, neg)
+    ref = _upd_a_steady(state, neg)
     got = alp.update_steady_pallas(state, neg, block_r=8, interpret=True)
     np.testing.assert_array_equal(
         np.asarray(ref.samples).view(np.uint32),
@@ -132,9 +137,9 @@ def test_non_divisible_r_pads_and_matches_xla():
     for R in (5, 60):
         k, B = 8, 64
         state = al.init(jr.key(7), R, k)
-        state = al.update(state, jax.lax.broadcasted_iota(jnp.int32, (R, B), 1))
+        state = _upd_a(state, jax.lax.broadcasted_iota(jnp.int32, (R, B), 1))
         batch = 1000 + jax.lax.broadcasted_iota(jnp.int32, (R, B), 1)
-        ref = al.update_steady(state, batch)
+        ref = _upd_a_steady(state, batch)
         got = alp.update_steady_pallas(state, batch, block_r=8, interpret=True)
         np.testing.assert_array_equal(np.asarray(ref.samples), np.asarray(got.samples))
         np.testing.assert_array_equal(np.asarray(ref.nxt), np.asarray(got.nxt))
@@ -148,9 +153,9 @@ def test_auto_block_r_and_chunked_gather_match_xla():
     R, k, B = 16, 8, 2048
     assert B > alp._GATHER_CHUNK_B
     state = al.init(jr.key(8), R, k)
-    state = al.update(state, jax.lax.broadcasted_iota(jnp.int32, (R, B), 1))
+    state = _upd_a(state, jax.lax.broadcasted_iota(jnp.int32, (R, B), 1))
     batch = 7777 + jax.lax.broadcasted_iota(jnp.int32, (R, B), 1)
-    ref = al.update_steady(state, batch)
+    ref = _upd_a_steady(state, batch)
     got = alp.update_steady_pallas(state, batch, interpret=True)
     np.testing.assert_array_equal(np.asarray(ref.samples), np.asarray(got.samples))
     np.testing.assert_array_equal(np.asarray(ref.nxt), np.asarray(got.nxt))
@@ -178,7 +183,7 @@ class TestGridPipelinedChunking:
         R, k, B = 8, 16, 64
         state, _ = _fill(jr.key(0), R, k, B)
         batch = 10_000 + jax.lax.broadcasted_iota(jnp.int32, (R, B), 1)
-        ref = al.update_steady(state, batch)
+        ref = _upd_a_steady(state, batch)
         got = alp.update_steady_pallas(
             state, batch, block_r=block_r, chunk_b=chunk_b,
             gather_chunk=gather_chunk, interpret=True,
@@ -199,7 +204,7 @@ class TestGridPipelinedChunking:
         nxt[2] = count[2] + 2 * chunk    # exactly a later boundary
         state = state._replace(nxt=jnp.asarray(nxt))
         batch = 5_000 + jax.lax.broadcasted_iota(jnp.int32, (R, B), 1)
-        ref = al.update_steady(state, batch)
+        ref = _upd_a_steady(state, batch)
         # the pinned lanes really do accept in this tile (the boundary is
         # exercised, not vacuously skipped)
         assert np.all(np.asarray(ref.nxt)[:3] != nxt[:3])
@@ -219,7 +224,7 @@ class TestGridPipelinedChunking:
         rng = np.random.default_rng(5)
         for _ in range(3):
             batch = jnp.asarray(rng.integers(1, 1 << 30, (R, B)), jnp.int32)
-            st_ref = al.update(st_ref, batch)
+            st_ref = _upd_a(st_ref, batch)
             st_pl = alp.update_pallas(
                 st_pl, batch, block_r=8, chunk_b=16, interpret=True
             )
@@ -231,7 +236,7 @@ class TestGridPipelinedChunking:
         R, k, B = 8, 8, 48
         state, _ = _fill(jr.key(3), R, k, B)
         batch = 400 + jax.lax.broadcasted_iota(jnp.int32, (R, B), 1)
-        ref = al.update_steady(state, batch)
+        ref = _upd_a_steady(state, batch)
         got = alp.update_steady_pallas(
             state, batch, block_r=8, chunk_b=13, interpret=True
         )
@@ -250,7 +255,7 @@ class TestFillCapableKernel:
         rng = np.random.default_rng(5)
         for _ in range(3):
             batch = jnp.asarray(rng.integers(1, 1 << 30, (R, B)), jnp.int32)
-            st_ref = al.update(st_ref, batch)
+            st_ref = _upd_a(st_ref, batch)
             st_pl = alp.update_pallas(st_pl, batch, block_r=32, interpret=True)
             _assert_state_equal(st_ref, st_pl)
 
@@ -260,7 +265,7 @@ class TestFillCapableKernel:
         R, k, B = 8, 32, 16
         st = al.init(jr.key(6), R, k)
         batch = 1 + jax.lax.broadcasted_iota(jnp.int32, (R, B), 1)
-        ref = al.update(st, batch)
+        ref = _upd_a(st, batch)
         got = alp.update_pallas(st, batch, interpret=True)
         _assert_state_equal(ref, got)
         assert np.all(np.asarray(got.count) == B)
@@ -274,9 +279,9 @@ class TestFillCapableKernel:
         # and must equal both XLA update_steady and the steady-only kernel
         R, k, B = 16, 8, 64
         st = al.init(jr.key(7), R, k)
-        st = al.update(st, 1 + jax.lax.broadcasted_iota(jnp.int32, (R, B), 1))
+        st = _upd_a(st, 1 + jax.lax.broadcasted_iota(jnp.int32, (R, B), 1))
         batch = 10_000 + jax.lax.broadcasted_iota(jnp.int32, (R, B), 1)
-        ref = al.update_steady(st, batch)
+        ref = _upd_a_steady(st, batch)
         got_fill = alp.update_pallas(st, batch, block_r=8, interpret=True)
         got_steady = alp.update_steady_pallas(
             st, batch, block_r=8, interpret=True

@@ -5,6 +5,7 @@ comparison is on the full state pytree (values, hash planes, size, count),
 not just results.  Runs the Mosaic interpreter on the CPU test mesh.
 """
 
+import jax
 import jax.numpy as jnp
 import jax.random as jr
 import numpy as np
@@ -12,6 +13,9 @@ import pytest
 
 from reservoir_tpu.ops import distinct as dd
 from reservoir_tpu.ops import distinct_pallas as dp
+
+# jitted XLA reference (see test_pallas_weighted._upd_w)
+_upd_d = jax.jit(dd.update)
 
 
 def _assert_state_equal(a, b):
@@ -30,7 +34,7 @@ def _assert_state_equal(a, b):
 def test_distinct_pallas_matches_xla_uniform(R, k, B):
     state = dd.init(jr.key(0), R, k)
     batch = jr.randint(jr.key(1), (R, B), 0, 1 << 30, jnp.int32)
-    ref = dd.update(state, batch)
+    ref = _upd_d(state, batch)
     got = dp.update_pallas(state, batch, block_r=8, interpret=True)
     _assert_state_equal(ref, got)
 
@@ -43,7 +47,7 @@ def test_distinct_pallas_heavy_duplication_chain():
     s_ref = s_pal = dd.init(jr.key(2), R, k)
     for step in range(5):
         batch = jr.randint(jr.fold_in(jr.key(3), step), (R, B), 0, 50, jnp.int32)
-        s_ref = dd.update(s_ref, batch)
+        s_ref = _upd_d(s_ref, batch)
         s_pal = dp.update_pallas(s_pal, batch, block_r=8, interpret=True)
         _assert_state_equal(s_ref, s_pal)
 
@@ -52,7 +56,7 @@ def test_distinct_pallas_negative_values():
     R, k, B = 8, 8, 32
     state = dd.init(jr.key(4), R, k)
     batch = jr.randint(jr.key(5), (R, B), -1000, 1000, jnp.int32)
-    ref = dd.update(state, batch)
+    ref = _upd_d(state, batch)
     got = dp.update_pallas(state, batch, block_r=8, interpret=True)
     _assert_state_equal(ref, got)
 
@@ -63,7 +67,7 @@ def test_distinct_pallas_wide_keys():
     state = dd.init(jr.key(6), R, k, sample_dtype=jnp.int64)
     hi = jr.bits(jr.key(7), (R, B), jnp.uint32)
     lo = jr.bits(jr.key(8), (R, B), jnp.uint32)
-    ref = dd.update(state, (hi, lo))
+    ref = _upd_d(state, (hi, lo))
     got = dp.update_pallas(state, (hi, lo), block_r=8, interpret=True)
     _assert_state_equal(ref, got)
 
@@ -79,7 +83,7 @@ def test_distinct_pallas_underfill_then_steady():
         jr.randint(jr.key(12), (R, B), 0, 1 << 20, jnp.int32),  # evicts
     ]
     for batch in batches:
-        s_ref = dd.update(s_ref, batch)
+        s_ref = _upd_d(s_ref, batch)
         s_pal = dp.update_pallas(s_pal, batch, block_r=8, interpret=True)
         _assert_state_equal(s_ref, s_pal)
 
@@ -102,7 +106,7 @@ def test_distinct_pallas_any_r_pads_and_matches_xla():
             batch = jr.randint(
                 jr.fold_in(jr.key(31), step), (R, B), 0, 300, jnp.int32
             )
-            s_ref = dd.update(s_ref, batch)
+            s_ref = _upd_d(s_ref, batch)
             s_pal = dp.update_pallas(s_pal, batch, block_r=8, interpret=True)
             np.testing.assert_array_equal(
                 np.asarray(s_ref.values), np.asarray(s_pal.values)
@@ -135,7 +139,7 @@ class TestGridPipelinedChunking:
             batch = jr.randint(
                 jr.fold_in(jr.key(51), step), (R, B), 0, 60, jnp.int32
             )
-            s_ref = dd.update(s_ref, batch)
+            s_ref = _upd_d(s_ref, batch)
             s_pal = dp.update_pallas(
                 s_pal, batch, block_r=block_r, chunk_b=chunk_b,
                 interpret=True,
@@ -160,7 +164,7 @@ class TestGridPipelinedChunking:
         batch[:, chunk - 5 : chunk + 5] = 7  # run splits the first boundary
         batch[:, 3 * chunk - 1 : 3 * chunk + 1] = 9  # and a later one
         batch = jnp.asarray(batch)
-        ref = dd.update(state, batch)
+        ref = _upd_d(state, batch)
         # the planted runs really are resident (the boundary is exercised,
         # not vacuously dropped), exactly once each (dedup)
         assert np.all(np.sum(np.asarray(ref.values) == 7, axis=1) == 1)
@@ -178,7 +182,7 @@ class TestGridPipelinedChunking:
         state = dd.init(jr.key(54), R, k, sample_dtype=jnp.int64)
         hi = jr.bits(jr.key(55), (R, B), jnp.uint32)
         lo = jr.bits(jr.key(56), (R, B), jnp.uint32)
-        ref = dd.update(state, (hi, lo))
+        ref = _upd_d(state, (hi, lo))
         for chunk_b in (8, 16):
             got = dp.update_pallas(
                 state, (hi, lo), block_r=8, chunk_b=chunk_b, interpret=True
@@ -189,7 +193,7 @@ class TestGridPipelinedChunking:
         R, k, B = 8, 8, 48
         state = dd.init(jr.key(57), R, k)
         batch = jr.randint(jr.key(58), (R, B), 0, 300, jnp.int32)
-        ref = dd.update(state, batch)
+        ref = _upd_d(state, batch)
         got = dp.update_pallas(
             state, batch, block_r=8, chunk_b=13, interpret=True
         )

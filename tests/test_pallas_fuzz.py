@@ -21,6 +21,15 @@ from reservoir_tpu.ops import distinct_pallas as dp
 from reservoir_tpu.ops import weighted as ww
 from reservoir_tpu.ops import weighted_pallas as wp
 
+# jitted XLA references: the eager op-by-op dispatch of the vmapped
+# updates costs several seconds per fuzz case on the single-core CI
+# runner; the jitted call runs the same trace (the equivalence every
+# parity suite in this repo already leans on)
+_upd_w = jax.jit(ww.update)
+_upd_d = jax.jit(dd.update)
+_upd_a = jax.jit(al.update)
+_upd_a_steady = jax.jit(al.update_steady)
+
 _RNG = np.random.default_rng(20260730)
 _CASES = [
     (
@@ -52,7 +61,7 @@ def test_fuzz_weighted(R, k, B, steps):
         e = jr.randint(key, (R, B), 0, 1 << 30, jnp.int32)
         w = jr.uniform(jr.fold_in(key, 1), (R, B)) * 3.0
         w = w * (jr.uniform(jr.fold_in(key, 2), (R, B)) > 0.25)  # zeros
-        s_ref = ww.update(s_ref, e, w)
+        s_ref = _upd_w(s_ref, e, w)
         # block_r=8: the default gate wants R % 64, but any divisor block
         # is legal — small blocks maximize grid-edge coverage here
         s_pal = wp.update_pallas(
@@ -68,7 +77,7 @@ def test_fuzz_distinct(R, k, B, steps):
     for step in range(steps):
         key = jr.fold_in(jr.key(9), step)
         b = jr.randint(key, (R, B), 0, max(4, R * B // 3), jnp.int32)
-        s_ref = dd.update(s_ref, b)
+        s_ref = _upd_d(s_ref, b)
         s_pal = dp.update_pallas(s_pal, b, chunk_b=chunk_b, interpret=True)
     _eq(s_ref, s_pal, ("values", "hash_hi", "hash_lo", "size", "count"))
 
@@ -101,7 +110,7 @@ def test_fuzz_algl_fill(R, k, B, steps):
     for step in range(steps + 1):  # +1: guarantee the boundary is crossed
         key = jr.fold_in(jr.key(13), step)
         b = jr.randint(key, (R, B), 0, 1 << 30, jnp.int32)
-        s_ref = al.update(s_ref, b)
+        s_ref = _upd_a(s_ref, b)
         s_pal = alp.update_pallas(
             s_pal, b, block_r=8, chunk_b=chunk_b, interpret=True
         )
@@ -114,13 +123,13 @@ def test_fuzz_algl_steady(R, k, B, steps):
     # random (block_r, chunk_b) grid decomposition per case
     s = al.init(jr.key(R * 1000 + k + 2), R, k)
     fill = jax.lax.broadcasted_iota(jnp.int32, (R, max(B, k)), 1)
-    s = al.update(s, fill)
+    s = _upd_a(s, fill)
     s_ref = s_pal = s
     chunk_b = _rand_chunk_b(B, R * 37 + k)
     for step in range(steps):
         key = jr.fold_in(jr.key(11), step)
         b = jr.randint(key, (R, B), 0, 1 << 30, jnp.int32)
-        s_ref = al.update_steady(s_ref, b)
+        s_ref = _upd_a_steady(s_ref, b)
         s_pal = alp.update_steady_pallas(
             s_pal, b, block_r=8, chunk_b=chunk_b, interpret=True
         )

@@ -16,6 +16,11 @@ import pytest
 from reservoir_tpu.ops import weighted as ww
 from reservoir_tpu.ops import weighted_pallas as wp
 
+# jitted XLA reference: eager op-by-op dispatch costs multiple seconds
+# per test on the single-core CI runner; same trace, same bits (every
+# parity suite already leans on that equivalence)
+_upd_w = jax.jit(ww.update)
+
 
 def _int_weights(key, R, B, lo=1, hi=5):
     # integer-valued f32 weights: cumsum partial sums are exact, so the two
@@ -36,7 +41,7 @@ def test_weighted_pallas_matches_vmap_from_empty(R, k, B):
     state = ww.init(jr.key(0), R, k)
     elems = jax.lax.broadcasted_iota(jnp.int32, (R, B), 1)
     weights = _int_weights(jr.key(1), R, B)
-    ref = ww.update(state, elems, weights)
+    ref = _upd_w(state, elems, weights)
     got = wp.update_pallas(state, elems, weights, block_r=8, interpret=True)
     _assert_state_equal(ref, got)
 
@@ -48,7 +53,7 @@ def test_weighted_pallas_zero_weight_contract():
     elems = jax.lax.broadcasted_iota(jnp.int32, (R, B), 1)
     weights = _int_weights(jr.key(3), R, B)
     weights = weights * (jr.uniform(jr.key(4), (R, B)) > 0.3)  # ~30% zeros
-    ref = ww.update(state, elems, weights)
+    ref = _upd_w(state, elems, weights)
     got = wp.update_pallas(state, elems, weights, block_r=8, interpret=True)
     _assert_state_equal(ref, got)
 
@@ -60,7 +65,7 @@ def test_weighted_pallas_multi_tile_chain():
     for step in range(4):
         elems = step * B + jax.lax.broadcasted_iota(jnp.int32, (R, B), 1)
         weights = _int_weights(jr.fold_in(jr.key(6), step), R, B)
-        s_ref = ww.update(s_ref, elems, weights)
+        s_ref = _upd_w(s_ref, elems, weights)
         s_pal = wp.update_pallas(
             s_pal, elems, weights, block_r=8, interpret=True
         )
@@ -74,7 +79,7 @@ def test_weighted_pallas_float_weights_exact():
     state = ww.init(jr.key(7), R, k)
     elems = jax.lax.broadcasted_iota(jnp.int32, (R, B), 1)
     weights = 0.25 + jr.uniform(jr.key(8), (R, B))
-    ref = ww.update(state, elems, weights)
+    ref = _upd_w(state, elems, weights)
     got = wp.update_pallas(state, elems, weights, block_r=8, interpret=True)
     _assert_state_equal(ref, got)
 
@@ -94,7 +99,7 @@ def test_weighted_pallas_any_r_pads_and_matches_xla():
         state = ww.init(jr.key(20), R, k)
         elems = jax.lax.broadcasted_iota(jnp.int32, (R, B), 1)
         weights = 0.5 + jr.uniform(jr.key(21), (R, B))
-        ref = ww.update(state, elems, weights)
+        ref = _upd_w(state, elems, weights)
         got = wp.update_pallas(state, elems, weights, block_r=8, interpret=True)
         np.testing.assert_array_equal(np.asarray(ref.samples), np.asarray(got.samples))
         np.testing.assert_array_equal(np.asarray(ref.lkeys), np.asarray(got.lkeys))

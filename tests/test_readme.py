@@ -38,14 +38,18 @@ def test_readme_snippet_runs(block):
 
 def test_distributed_stream_example_runs():
     # the long-context example must stay executable (same contract as the
-    # README snippets): narrow + wide merges over the virtual mesh
+    # README snippets): narrow + wide merges over the virtual mesh.
+    # 4 virtual devices, not the example's default 8: the executability
+    # contract is device-count-independent (one tree-fold level is enough
+    # to exercise narrow AND wide merges) and the 8-way fold costs ~2x
+    # the single-core CI wall time for no extra coverage.
     import subprocess
     import sys
     import os
 
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     proc = subprocess.run(
-        [sys.executable, os.path.join(repo, "examples", "distributed_stream.py"), "8"],
+        [sys.executable, os.path.join(repo, "examples", "distributed_stream.py"), "4"],
         capture_output=True,
         text=True,
         timeout=600,

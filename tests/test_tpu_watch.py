@@ -552,7 +552,8 @@ def test_post_step_rehearsal_sequential_gating(tmp_path, monkeypatch):
     assert any("--kernel weighted" in r for r in ran)
     assert [s[0] for s in remaining] == [
         "distinct_sweep", "pallas_device_tests", "algl_best_block",
-        "serve_soak", "ha_rehearsal", "recovery_rehearsal",
+        "serve_soak", "ha_rehearsal", "gated_sweep", "gated_rehearsal",
+        "recovery_rehearsal",
     ]
     assert committed == ["3 post-step(s) recorded"]
     rows = [

@@ -23,6 +23,13 @@ from reservoir_tpu.engine import ReservoirEngine
 from reservoir_tpu.oracle.weighted import AExpJOracle, NaiveWeightedOracle
 from reservoir_tpu.ops import weighted as wd
 
+# ONE jitted update shared by the whole file: the eager op-by-op dispatch
+# of the vmapped update costs ~5x wall on the single-core CI runner by
+# mid-suite (thousands of tiny op dispatches), while the jitted call runs
+# the same trace -- the in-file `_update` sites already relied
+# on exactly that equivalence.
+_update = jax.jit(wd.update)
+
 
 def inclusion_freq_oracle(cls, k, items, weights, trials, seed0):
     n = len(items)
@@ -102,7 +109,7 @@ class TestDeviceKernel:
     def test_fill_arrival_order_under_k(self):
         state = wd.init(jr.key(0), 2, 8)
         elems = jnp.arange(10, dtype=jnp.int32).reshape(2, 5)
-        state = wd.update(state, elems, jnp.ones((2, 5), jnp.float32))
+        state = _update(state, elems, jnp.ones((2, 5), jnp.float32))
         samples, size = wd.result(state)
         assert np.all(np.asarray(size) == 5)
         np.testing.assert_array_equal(np.asarray(samples)[:, :5], np.asarray(elems))
@@ -113,9 +120,9 @@ class TestDeviceKernel:
         rng = np.random.default_rng(5)
         elems = rng.integers(0, 1 << 30, (R, N)).astype(np.int32)
         weights = rng.integers(1, 8, (R, N)).astype(np.float32)  # f32-exact sums
-        ref = wd.update(wd.init(jr.key(6), R, k), jnp.asarray(elems), jnp.asarray(weights))
+        ref = _update(wd.init(jr.key(6), R, k), jnp.asarray(elems), jnp.asarray(weights))
         state = wd.init(jr.key(6), R, k)
-        step = jax.jit(wd.update)  # [1]*30 re-traces once per width, not 30x
+        step = _update  # [1]*30 re-traces once per width, not 30x
         start = 0
         for b in tiles:
             state = step(
@@ -131,7 +138,7 @@ class TestDeviceKernel:
     def test_equal_weights_uniform_5_sigma(self):
         R, n, k = 20_000, 10, 5
         elems = jnp.tile(jnp.arange(n, dtype=jnp.int32), (R, 1))
-        state = wd.update(wd.init(jr.key(7), R, k), elems, jnp.ones((R, n), jnp.float32))
+        state = _update(wd.init(jr.key(7), R, k), elems, jnp.ones((R, n), jnp.float32))
         samples, size = wd.result(state)
         assert np.all(np.asarray(size) == k)
         counts = np.bincount(np.asarray(samples).ravel(), minlength=n)
@@ -144,7 +151,7 @@ class TestDeviceKernel:
         p = weights_row / weights_row.sum()
         elems = jnp.tile(jnp.arange(n, dtype=jnp.int32), (R, 1))
         weights = jnp.tile(jnp.asarray(weights_row), (R, 1))
-        state = wd.update(wd.init(jr.key(8), R, 1), elems, weights)
+        state = _update(wd.init(jr.key(8), R, 1), elems, weights)
         samples, _ = wd.result(state)
         freq = np.bincount(np.asarray(samples)[:, 0], minlength=n) / R
         sigma = np.sqrt(p * (1 - p) / R)
@@ -157,7 +164,7 @@ class TestDeviceKernel:
         weights_row = np.asarray([1.0 / (i + 1) for i in range(n)], np.float32)
         elems = jnp.tile(jnp.arange(n, dtype=jnp.int32), (R, 1))
         weights = jnp.tile(jnp.asarray(weights_row), (R, 1))
-        state = wd.update(wd.init(jr.key(9), R, k), elems, weights)
+        state = _update(wd.init(jr.key(9), R, k), elems, weights)
         samples, size = wd.result(state)
         assert np.all(np.asarray(size) == k)
         f_dev = np.bincount(np.asarray(samples).ravel(), minlength=n) / R
@@ -308,7 +315,7 @@ class TestZeroWeightContract:
         elems = jnp.tile(jnp.arange(B, dtype=jnp.int32), (R, 1))
         # odd elements get weight 0: they must never appear
         w = jnp.tile((jnp.arange(B) % 2 == 0).astype(jnp.float32), (R, 1))
-        state = wd.update(wd.init(jr.key(0), R, k), elems, w)
+        state = _update(wd.init(jr.key(0), R, k), elems, w)
         samples, size = wd.result(state)
         assert np.all(np.asarray(size) == k)
         assert np.all(np.asarray(samples) % 2 == 0)
@@ -317,7 +324,7 @@ class TestZeroWeightContract:
     def test_kernel_all_zero_weights_empty_result(self):
         R, k, B = 2, 4, 32
         elems = jnp.ones((R, B), jnp.int32)
-        state = wd.update(
+        state = _update(
             wd.init(jr.key(1), R, k), elems, jnp.zeros((R, B), jnp.float32)
         )
         samples, size = wd.result(state)
@@ -332,10 +339,10 @@ class TestZeroWeightContract:
         w = jnp.asarray(
             [[0, 1, 0, 1, 0, 1, 0, 1, 0, 1, 0, 1]], jnp.float32
         )
-        joint = wd.update(wd.init(jr.key(2), R, k), elems, w)
+        joint = _update(wd.init(jr.key(2), R, k), elems, w)
         split = wd.init(jr.key(2), R, k)
         for sl in (slice(0, 5), slice(5, 7), slice(7, 12)):
-            split = wd.update(split, elems[:, sl], w[:, sl])
+            split = _update(split, elems[:, sl], w[:, sl])
         np.testing.assert_array_equal(
             np.asarray(joint.samples), np.asarray(split.samples)
         )
@@ -352,7 +359,7 @@ class TestZeroWeightContract:
         R, k, B = 8000, 4, 16
         elems = jnp.tile(jnp.arange(B, dtype=jnp.int32), (R, 1))
         w = jnp.tile((jnp.arange(B) < 8).astype(jnp.float32), (R, 1))
-        state = wd.update(wd.init(jr.key(3), R, k), elems, w)
+        state = _update(wd.init(jr.key(3), R, k), elems, w)
         samples, size = wd.result(state)
         picked = np.asarray(samples)[:, :k].ravel()
         counts = np.bincount(picked, minlength=B)
@@ -443,7 +450,7 @@ def test_device_zero_weight_mixed_magnitude_no_nan():
     R, k, B = 8, 16, 256
     rng = np.random.default_rng(7)
     st = wd.init(jr.key(0), R, k)
-    step = jax.jit(wd.update)  # one trace for the 30 tiles, not 30
+    step = _update  # one trace for the 30 tiles, not 30
     for _ in range(30):
         e = jnp.asarray(
             rng.integers(0, 1 << 30, (R, B), dtype=np.int64).astype(np.int32)

@@ -52,6 +52,7 @@ __all__ = ["collect", "render", "main"]
 #: Histograms worth a latency row, in display order.
 _LATENCY_ROWS = (
     ("bridge.flush_s", "flush (device dispatch)"),
+    ("gate.eval_s", "gate eval (skip-ahead)"),
     ("bridge.journal_append_s", "journal append"),
     ("bridge.journal_fsync_s", "journal fsync"),
     ("checkpoint.write_s", "checkpoint write"),

@@ -73,6 +73,17 @@ CONFIG_BUDGETS: dict[str, tuple[float, dict[str, str]]] = {
     ),
     "stream": (420.0, {"RESERVOIR_BENCH_SELFTEST": "0"}),
     "bridge": (420.0, {"RESERVOIR_BENCH_SELFTEST": "0"}),
+    # the ISSUE-8 ingest-side skip gate: gated-vs-ungated A/B at
+    # n/k >= 10^4 with bit-identity asserted in-run; carries the embedded
+    # selftest so the row pins gated_parity (host-CPU replica vs TPU
+    # engine transcendentals) alongside the throughput number
+    "gated": (
+        700.0,
+        {
+            "RESERVOIR_BENCH_SELFTEST": "1",
+            "RESERVOIR_BENCH_SELFTEST_TIMEOUT": "300",
+        },
+    ),
     "bridge_serial": (420.0, {"RESERVOIR_BENCH_SELFTEST": "0"}),
     "transfer": (240.0, {"RESERVOIR_BENCH_SELFTEST": "0"}),
     # the ISSUE-4 serving plane: sessions/sec + live-snapshot latency on
@@ -94,7 +105,7 @@ CONFIG_BUDGETS: dict[str, tuple[float, dict[str, str]]] = {
 # a CONFIG_BUDGETS row (an unbudgeted config can burn a whole window).
 DEFAULT_CONFIGS = (
     "algl,algl_chunk1024,algl_chunk0,distinct,weighted,stream,bridge,"
-    "bridge_serial,serve,ha,traffic,algl_B4096"
+    "bridge_serial,gated,serve,ha,traffic,algl_B4096"
 )
 
 def _now() -> str:
@@ -459,6 +470,38 @@ POST_STEPS: list[tuple[str, list[str], float, dict]] = [
             "rehearsal",
         ],
         600.0,
+        {"RESERVOIR_TPU_TEST_PLATFORM": "native"},
+    ),
+    (
+        # gated sweep (ISSUE 8): re-capture the skip-gate A/B at a wider
+        # candidate tile — one window answers whether gate_tile is a
+        # lever worth autotuning on real hardware.  Budget-capped; the
+        # headline gate_tile=64 row rides DEFAULT_CONFIGS as `gated`.
+        "gated_sweep",
+        [sys.executable, os.path.join(REPO, "bench.py")],
+        600.0,
+        {
+            "RESERVOIR_BENCH_CONFIG": "gated",
+            "RESERVOIR_BENCH_GATE_CAP": "256",
+            "RESERVOIR_BENCH_SELFTEST": "0",
+        },
+    ),
+    (
+        # gated bit-reconciliation rehearsal (ISSUE 8): the gate matrix —
+        # parity across modes, chunk splits, kill->recover replay — run
+        # against the real backend, budget-capped like its siblings
+        "gated_rehearsal",
+        [
+            sys.executable,
+            "-m",
+            "pytest",
+            "tests/test_gate.py",
+            "-q",
+            "--no-header",
+            "-k",
+            "reconcil or recover or soak",
+        ],
+        900.0,
         {"RESERVOIR_TPU_TEST_PLATFORM": "native"},
     ),
     (
