@@ -774,6 +774,126 @@ def _bench_ha(S, k, B, steps, reps):
     return times, stages
 
 
+def _bench_shards(S, k, B, steps, reps):
+    """Sharded serving plane (ISSUE 9, ROADMAP 1): a
+    ``ShardedReservoirService`` fronting N independent shard units (each
+    with its own engine/bridge/journal/fence and a hot standby), fed by
+    hash-routed sessions at half occupancy.  The row's currency is the
+    robustness economics of sharding: **per-shard ingest rate** (does
+    routing + N journals tax the serve path), **kill-one-shard failover
+    time** (the 1/N-outage promise: one ``promote()`` on the victim while
+    every other shard would keep serving), and **merged-snapshot
+    latency** (the cross-shard one-logical-sample read,
+    ``parallel/merge.py``'s host tree).  Failover and merge quantiles are
+    sourced from the telemetry registry (``ha.promote_s``,
+    ``cluster.merge_s``) like the ``ha`` row.
+
+    Env knobs: RESERVOIR_BENCH_SHARDS (shard count, default 4).  ``S`` is
+    the PER-SHARD row capacity; the pass opens ``SHARDS * S / 2``
+    sessions so hash skew cannot overflow any one shard's table."""
+    import shutil
+    import tempfile
+
+    from reservoir_tpu import SamplerConfig, obs
+    from reservoir_tpu.serve import ShardedReservoirService
+
+    n_shards = int(os.environ.get("RESERVOIR_BENCH_SHARDS", 4))
+    victim = n_shards - 1
+    cfg = SamplerConfig(max_sample_size=k, num_reservoirs=S, tile_size=B)
+    n_sessions = max(n_shards, n_shards * S // 2)
+    keys = [f"u{i}" for i in range(n_sessions)]
+    rng = np.random.default_rng(0)
+    chunks = [
+        rng.integers(0, 1 << 31, (n_sessions, B), dtype=np.int64).astype(
+            np.int32
+        )
+        for _ in range(steps)
+    ]
+    merge_groups = [
+        [keys[int(j)] for j in rng.integers(0, n_sessions, 8)]
+        for _ in range(8)
+    ]
+
+    def one_pass(r, collect=None):
+        cl_dir = tempfile.mkdtemp(prefix="reservoir_shards_bench_")
+        try:
+            cluster = ShardedReservoirService(
+                cfg,
+                n_shards,
+                cl_dir,
+                key=r,
+                checkpoint_every=1 << 30,  # replication rides the journal
+                coalesce_bytes=1 << 20,
+            )
+            for key in keys:
+                cluster.open_session(key)
+            cluster.sync()
+            t0 = time.perf_counter()
+            for s in range(steps):
+                for i, key in enumerate(keys):
+                    cluster.ingest(key, chunks[s][i])
+                cluster.sync()
+                cluster.poll()
+            ingest_wall = time.perf_counter() - t0
+            if collect is not None:
+                # BEFORE the kill: the promoted standby's metric block
+                # restarts at zero and would misreport the victim's rate
+                collect["per_shard_elem_s"] = {
+                    str(u.shard_id): round(
+                        u.service.metrics.ingested_elements / ingest_wall,
+                        2,
+                    )
+                    for u in cluster.units
+                }
+            for group in merge_groups:
+                cluster.merged_snapshot(group)  # observed: cluster.merge_s
+            # the 1/N-outage drill: kill ONE shard, promote its standby —
+            # observed into ha.promote_s; the other shards' primaries are
+            # untouched the whole time
+            cluster.kill_shard(victim)
+            cluster.promote_shard(victim, reason="bench kill-one-shard")
+            if collect is not None:
+                collect["serve"] = cluster.metrics_snapshot()
+            cluster.shutdown()
+            return ingest_wall
+        finally:
+            shutil.rmtree(cl_dir, ignore_errors=True)
+
+    one_pass(0)  # warm: compiles every flush shape + the merge tree
+    # fresh registry AFTER the warm pass: quantiles cover timed reps only
+    reg = obs.enable(obs.Registry())
+    try:
+        times, detail = [], {}
+        for r in range(1, reps + 1):
+            times.append(
+                one_pass(r, collect=detail if r == reps else None)
+            )
+        promote = reg.histogram("ha.promote_s")
+        merge = reg.histogram("cluster.merge_s")
+        stages = {
+            "shards": n_shards,
+            "per_shard_rows": S,
+            "sessions": n_sessions,
+            "victim_shard": victim,
+            "elements": n_sessions * B * steps,
+            "per_shard_elem_s": detail.get("per_shard_elem_s", {}),
+            "failover_ms_best": round(promote.min * 1e3, 3),
+            "failover_ms_median": round(promote.quantile(0.5) * 1e3, 3),
+            "merge_p50_ms": round(merge.quantile(0.5) * 1e3, 4),
+            "merge_p99_ms": round(merge.quantile(0.99) * 1e3, 4),
+            "merges": merge.count,
+            "serve": detail.get("serve", {}),
+            "telemetry": _telemetry_summary(
+                reg,
+                ("cluster.merge_s", "ha.promote_s", "bridge.flush_s",
+                 "bridge.journal_append_s"),
+            ),
+        }
+    finally:
+        obs.disable()
+    return times, stages
+
+
 def _bench_transfer(S, k, B, steps, reps):
     """RAW host->device transfer bandwidth at the bridge's tile shape — the
     wire ceiling the bridge number is judged against (VERDICT r2 item 3:
@@ -942,11 +1062,11 @@ def main() -> None:
     impl = os.environ.get("RESERVOIR_BENCH_IMPL", "auto")
     if config not in (
         "algl", "distinct", "weighted", "bridge", "stream", "host",
-        "transfer", "serve", "ha", "traffic", "gated",
+        "transfer", "serve", "ha", "traffic", "gated", "shards",
     ):
         raise SystemExit(
             "RESERVOIR_BENCH_CONFIG must be algl|distinct|weighted|bridge|"
-            f"stream|host|transfer|serve|ha|traffic|gated, got {config!r}"
+            f"stream|host|transfer|serve|ha|traffic|gated|shards, got {config!r}"
         )
     if impl not in ("auto", "xla", "pallas"):
         raise SystemExit(
@@ -978,6 +1098,11 @@ def main() -> None:
             # ha: the row is judged on failover-time-ms + replication lag
             "ha": (32 if smoke else 1024, 8 if smoke else 32,
                    16 if smoke else 256),
+            # shards: R is the PER-SHARD row capacity; the row is judged
+            # on per-shard ingest rate + kill-one-shard failover time +
+            # merged-snapshot latency (ISSUE 9)
+            "shards": (24 if smoke else 512, 8 if smoke else 32,
+                       16 if smoke else 256),
             # traffic: R is the TABLE capacity; the loadgen universe
             # overcommits it (>= 10k simulated sessions non-smoke) and
             # the row is judged on corrected wait + SLO verdicts
@@ -995,6 +1120,7 @@ def main() -> None:
             "transfer": 2 if smoke else 4,
             "serve": 2 if smoke else 4,
             "ha": 2 if smoke else 4,
+            "shards": 2 if smoke else 4,
             # traffic: steps scales arrivals (steps * universe)
             "traffic": 2,
             "gated": 4 if smoke else 40,
@@ -1199,6 +1325,9 @@ def main() -> None:
         elif config == "ha":
             times, ha_stages = _bench_ha(R, k, B, steps, reps)
             tag = "ha_replicated_feed"
+        elif config == "shards":
+            times, shards_stages = _bench_shards(R, k, B, steps, reps)
+            tag = "shards_cluster_feed"
         elif config == "traffic":
             times, traffic_stages = _bench_traffic(R, k, B, steps, reps)
             tag = "traffic_loadgen"
@@ -1209,6 +1338,10 @@ def main() -> None:
             times, bridge_stages = _bench_bridge(R, k, B, steps, reps)
             tag = "bridge_host_feed"
     n_elems = R * B * steps
+    if config == "shards":
+        # sessions are hash-routed at half occupancy, not R*B*steps —
+        # the honest element count is what the cluster actually ingested
+        n_elems = shards_stages["elements"]
     if config == "traffic":
         # arrivals are drawn from the declared process, not R*B*steps —
         # the honest element count is what the loadgen actually ingested
@@ -1239,6 +1372,14 @@ def main() -> None:
         record["failover_ms"] = ha_stages["failover_ms_best"]
         record["lag_seq"] = ha_stages["lag_seq_max"]
         record["lag_s"] = ha_stages["lag_s_p50"]
+    if config == "shards":
+        # the shards row's real currency: the 1/N-outage economics —
+        # kill-one-shard failover time, per-shard ingest rate, and the
+        # cross-shard merged-snapshot read (ISSUE 9 acceptance surface)
+        record["stages"] = shards_stages
+        record["shards"] = shards_stages["shards"]
+        record["failover_ms"] = shards_stages["failover_ms_best"]
+        record["merge_p99_ms"] = shards_stages["merge_p99_ms"]
     if config == "gated":
         # the gated row's real currency: effective elem/s vs the ungated
         # A/B, plus the skip fraction that earned it (ISSUE 8 acceptance:

@@ -133,6 +133,24 @@ class ServiceSaturated(RuntimeError):
         self.retry_after_s = retry_after_s
 
 
+class ShardUnavailable(ServiceSaturated):
+    """The shard a session routes to cannot serve right now — its primary
+    is fenced (a standby promotion is in flight), killed, or not yet
+    recovered.  A :class:`ServiceSaturated` subclass: to a client this is
+    the same verdict (back off ``retry_after_s`` and retry the SAME key —
+    routing is deterministic, the session never moves), and crucially it
+    is scoped to ONE shard: every session routed elsewhere keeps serving.
+    ``shard`` names the failure domain, ``reason`` why it rejected."""
+
+    def __init__(
+        self, message: str, retry_after_s: float, shard: int,
+        reason: str = "unavailable",
+    ) -> None:
+        super().__init__(message, retry_after_s)
+        self.shard = int(shard)
+        self.reason = reason
+
+
 @dataclasses.dataclass(frozen=True)
 class RetryPolicy:
     """Bounded, jittered exponential backoff for *transient* flush failures.

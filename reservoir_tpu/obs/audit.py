@@ -78,6 +78,10 @@ class SampleQualityAuditor:
         noise, not bias).
       stratum_gate: maximum relative deviation of a stratum's inclusion
         rate from the pooled mean before it counts as a breach.
+      obs_scope: per-shard instrument label (ISSUE 9): when set, the
+        ``audit.*`` instruments are recorded under scoped names
+        (``audit.ks_checks@<scope>``) so each shard's auditor feeds its
+        own ``sample_quality`` objective (``default_slos(scope=...)``).
     """
 
     def __init__(
@@ -89,6 +93,7 @@ class SampleQualityAuditor:
         stratum_of: Optional[Callable[[np.ndarray], np.ndarray]] = None,
         min_stratum_count: int = 256,
         stratum_gate: float = 0.5,
+        obs_scope: Optional[str] = None,
     ) -> None:
         if min_pool < 8:
             raise ValueError("min_pool must be at least 8")
@@ -100,6 +105,7 @@ class SampleQualityAuditor:
         self._stratum_of = stratum_of
         self._min_stratum = int(min_stratum_count)
         self._stratum_gate = float(stratum_gate)
+        self._obs_scope = obs_scope
         self._pool: List[np.ndarray] = []
         self._pool_n = 0
         self._pool_sessions = 0
@@ -172,12 +178,12 @@ class SampleQualityAuditor:
         ks = ks_one_sample_uniform(pooled, 1)
         gate = max(KS_GATE, self._ks_crit / math.sqrt(m))
         self.last_ks = ks
-        reg.gauge("audit.ks_statistic").set(ks)
-        reg.gauge("audit.ks_gate").set(gate)
-        reg.gauge("audit.pool_size").set(m)
-        reg.counter("audit.ks_checks").inc()
+        reg.gauge(_obs.scoped("audit.ks_statistic", self._obs_scope)).set(ks)
+        reg.gauge(_obs.scoped("audit.ks_gate", self._obs_scope)).set(gate)
+        reg.gauge(_obs.scoped("audit.pool_size", self._obs_scope)).set(m)
+        reg.counter(_obs.scoped("audit.ks_checks", self._obs_scope)).inc()
         if ks > gate:
-            reg.counter("audit.ks_breaches").inc()
+            reg.counter(_obs.scoped("audit.ks_breaches", self._obs_scope)).inc()
             _obs.emit(
                 "audit.ks_breach",
                 site="obs.audit",
@@ -197,11 +203,11 @@ class SampleQualityAuditor:
         mean = self._included[eligible].sum() / self._ingested[eligible].sum()
         dev = float(np.abs(rates / mean - 1.0).max())
         self.last_stratum_dev = dev
-        reg.gauge("audit.stratum_dev").set(dev)
-        reg.counter("audit.stratum_checks").inc()
+        reg.gauge(_obs.scoped("audit.stratum_dev", self._obs_scope)).set(dev)
+        reg.counter(_obs.scoped("audit.stratum_checks", self._obs_scope)).inc()
         if dev > self._stratum_gate:
             worst = int(np.argmax(np.abs(rates / mean - 1.0)))
-            reg.counter("audit.stratum_breaches").inc()
+            reg.counter(_obs.scoped("audit.stratum_breaches", self._obs_scope)).inc()
             _obs.emit(
                 "audit.stratum_breach",
                 site="obs.audit",

@@ -52,7 +52,21 @@ __all__ = [
     "emit",
     "register_block",
     "blocks",
+    "scoped",
 ]
+
+#: Separator of the per-shard instrument-label convention (ISSUE 9):
+#: ``serve.ingest_s@shard0`` is shard 0's admission histogram — same
+#: metric family as the unscoped name, disjoint instrument.  Exporters
+#: need no special handling (a scoped name is just a name); SLO planes
+#: scope their specs with :func:`~reservoir_tpu.obs.slo.default_slos`'s
+#: ``scope=`` so each failure domain is judged on its own instruments.
+SCOPE_SEP = "@"
+
+
+def scoped(name: str, scope: Optional[str] = None) -> str:
+    """``name`` labeled with an instrument scope (``None`` = unscoped)."""
+    return name if not scope else f"{name}{SCOPE_SEP}{scope}"
 
 
 class Counter:

@@ -205,18 +205,30 @@ def default_slos(
     quality_budget: float = 0.05,
     short_window_s: float = 300.0,
     long_window_s: float = 3600.0,
+    scope: Optional[str] = None,
 ) -> Tuple[SLOSpec, ...]:
     """The serving plane's standard objective set: ingest/snapshot latency,
     snapshot staleness, admission error rate, and sample quality — the
-    four axes ``bench.py traffic`` reports and ``reservoir_top`` panels."""
+    four axes ``bench.py traffic`` reports and ``reservoir_top`` panels.
+
+    ``scope`` labels every instrument name with a per-shard scope
+    (ISSUE 9, :func:`~reservoir_tpu.obs.registry.scoped`): a cluster runs
+    one :class:`SLOPlane` per shard over ``serve.*@shardN`` instruments,
+    so one saturated shard pages alone while its neighbors stay ``ok``.
+    Spec names are unchanged — planes are per-shard objects, so dashboards
+    join on the same objective names across shards."""
     common = dict(
         short_window_s=short_window_s, long_window_s=long_window_s
     )
+
+    def _n(name: str) -> str:
+        return _obs.scoped(name, scope)
+
     return (
         SLOSpec(
             "ingest_latency_p99",
             "latency_quantile",
-            "serve.ingest_s",
+            _n("serve.ingest_s"),
             threshold=ingest_p99_s,
             quantile=0.99,
             **common,
@@ -224,7 +236,7 @@ def default_slos(
         SLOSpec(
             "snapshot_latency_p99",
             "latency_quantile",
-            "serve.snapshot_s",
+            _n("serve.snapshot_s"),
             threshold=snapshot_p99_s,
             quantile=0.99,
             **common,
@@ -232,7 +244,7 @@ def default_slos(
         SLOSpec(
             "snapshot_staleness_p99",
             "staleness",
-            "serve.snapshot_staleness_s",
+            _n("serve.snapshot_staleness_s"),
             threshold=staleness_s,
             quantile=0.99,
             **common,
@@ -240,18 +252,18 @@ def default_slos(
         SLOSpec(
             "ingest_error_rate",
             "error_rate",
-            "serve.ingest_errors",
-            total_instrument="serve.ingest_total",
+            _n("serve.ingest_errors"),
+            total_instrument=_n("serve.ingest_total"),
             budget=error_budget,
             **common,
         ),
         SLOSpec(
             "sample_quality",
             "sample_quality",
-            "audit.ks_breaches",
-            total_instrument="audit.ks_checks",
+            _n("audit.ks_breaches"),
+            total_instrument=_n("audit.ks_checks"),
             budget=quality_budget,
-            value_instrument="audit.ks_statistic",
+            value_instrument=_n("audit.ks_statistic"),
             **common,
         ),
     )
@@ -273,6 +285,10 @@ class SLOPlane:
       clock: time source (injectable for deterministic window tests).
       max_frames: bounded history (frames arrive at evaluation cadence;
         the default covers an hour-long window at one-second beats).
+      attach: publish this plane on its registry (``registry.slo_plane``)
+        so exporters pick the verdicts up.  Per-shard planes (ISSUE 9)
+        pass ``False`` — N shard planes must not fight over the one
+        registry slot; the cluster aggregates their verdicts itself.
     """
 
     def __init__(
@@ -282,7 +298,9 @@ class SLOPlane:
         *,
         clock=time.time,
         max_frames: int = 4096,
+        attach: bool = True,
     ) -> None:
+        self._attach = bool(attach)
         self.specs: Tuple[SLOSpec, ...] = tuple(
             specs if specs is not None else default_slos()
         )
@@ -303,7 +321,11 @@ class SLOPlane:
 
     def _resolve(self) -> Optional[Registry]:
         reg = self._registry if self._registry is not None else _obs.get()
-        if reg is not None and getattr(reg, "slo_plane", None) is not self:
+        if (
+            self._attach
+            and reg is not None
+            and getattr(reg, "slo_plane", None) is not self
+        ):
             reg.slo_plane = self  # exporters find the plane via its registry
         return reg
 

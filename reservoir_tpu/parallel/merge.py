@@ -21,7 +21,12 @@ collective-free.
 
 from __future__ import annotations
 
+from typing import Sequence, Tuple
+
+import numpy as np
+
 import jax
+import jax.numpy as jnp
 import jax.random as jr
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
@@ -34,7 +39,82 @@ __all__ = [
     "uniform_stream_merger",
     "distinct_stream_merger",
     "weighted_stream_merger",
+    "merge_samples_host",
 ]
+
+_HOST_PAIRWISE = None  # lazily jitted merge_samples (host tree merges)
+
+
+def merge_samples_host(
+    parts: Sequence[Tuple[np.ndarray, int]],
+    key,
+    *,
+    max_sample_size: int,
+) -> Tuple[np.ndarray, int]:
+    """Host-side log-depth tree merge of per-shard uniform samples.
+
+    The sharded serving plane (ISSUE 9) routes whole sessions to shards,
+    so a cross-shard "one logical sample" query — N sessions, possibly on
+    N different shards, read as a single uniform sample of their combined
+    streams — merges *host* snapshot arrays, not meshed device state.
+    This is the same exact hypergeometric pairwise merge the mesh mergers
+    ride (:func:`reservoir_tpu.ops.algorithm_l.merge_samples`, one
+    reservoir row per part), combined by the same deterministic log-depth
+    node-numbered tree as :func:`uniform_stream_merger` — so for a fixed
+    ``key`` and part order the result is bit-reproducible, and a
+    single-shard oracle that merges its per-session oracle replays with
+    this very function reconciles bit-for-bit (pinned by
+    ``tests/test_cluster.py``).
+
+    Args:
+      parts: ``(sample, count)`` pairs — each sample a 1-D array already
+        truncated to its fill (``ReservoirService.snapshot`` output), each
+        count that session's total stream length.
+      key: PRNG key or int seed for the merge draws.
+      max_sample_size: the configs' ``k`` (merged size is
+        ``min(sum(counts), k)``).
+
+    Returns ``(merged_sample, total_count)`` with the merged sample
+    truncated to its size.  Uniform (plain) mode only: weighted/distinct
+    merges are state-keyed (ES keys / hash planes) and ride the mesh
+    mergers below.
+    """
+    if not parts:
+        raise ValueError("merge_samples_host needs at least one part")
+    k = int(max_sample_size)
+    if isinstance(key, int):
+        key = jr.key(key)
+    dtype = np.asarray(parts[0][0]).dtype
+    global _HOST_PAIRWISE
+    if _HOST_PAIRWISE is None:
+        # one jitted pairwise merge, shape/dtype-cached by jit itself:
+        # the eager k-step scan costs ~100x per pair on the host path
+        _HOST_PAIRWISE = jax.jit(_algl.merge_samples)
+
+    def _lift(sample, count):
+        arr = np.zeros((1, k), dtype)
+        s = np.atleast_1d(np.asarray(sample, dtype))[:k]
+        arr[0, : s.shape[0]] = s
+        return jnp.asarray(arr), jnp.asarray([int(count)], jnp.uint32)
+
+    items = [_lift(s, c) for s, c in parts]
+    node = 0
+    while len(items) > 1:
+        nxt = []
+        for i in range(0, len(items) - 1, 2):
+            node += 1
+            s, c = _HOST_PAIRWISE(
+                items[i][0], items[i][1],
+                items[i + 1][0], items[i + 1][1],
+                jr.fold_in(key, node),
+            )
+            nxt.append((s, c))
+        if len(items) % 2:
+            nxt.append(items[-1])
+        items = nxt
+    samples, count = items[0]
+    total = int(np.asarray(count)[0])
+    return np.asarray(samples)[0, : min(total, k)], total
 
 
 def uniform_stream_merger(mesh: Mesh, axis: str = "stream"):

@@ -27,18 +27,33 @@ one-shot reads.  The serve layer adds the missing multiplexing plane:
   (:class:`~reservoir_tpu.serve.ha.HeartbeatWriter`) and performs
   **epoch-fenced** promotion — the fenced old primary fails its next
   durable write with :class:`~reservoir_tpu.errors.FencedError` instead
-  of double-serving.
+  of double-serving;
+- :mod:`.shard` / :mod:`.cluster` — the sharded serving plane (ISSUE 9):
+  a :class:`~reservoir_tpu.serve.cluster.ShardedReservoirService` fronts
+  N fully independent :class:`~reservoir_tpu.serve.shard.ShardUnit`
+  failure domains (engine + bridge + journal dir + epoch fence + hot
+  standby each) behind deterministic hash routing with a pinned,
+  journaled routing epoch — one fenced/killed/saturated shard rejects
+  only its own sessions (:class:`~reservoir_tpu.errors.ShardUnavailable`
+  with ``retry_after_s``) while the rest keep serving, and cross-shard
+  merged snapshots ride the exact mergeable-reservoir math
+  (:func:`~reservoir_tpu.parallel.merge.merge_samples_host`).
 """
 
+from .cluster import ShardedReservoirService, shard_of
 from .ha import FailoverController, HealthReport, HeartbeatWriter, read_heartbeat
 from .replica import JournalFollower, StandbyReplica
 from .service import ReservoirService
 from .sessions import Session, SessionTable
+from .shard import ShardUnit
 
 __all__ = [
     "ReservoirService",
     "Session",
     "SessionTable",
+    "ShardUnit",
+    "ShardedReservoirService",
+    "shard_of",
     "StandbyReplica",
     "JournalFollower",
     "FailoverController",

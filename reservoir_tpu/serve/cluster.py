@@ -1,0 +1,565 @@
+"""Sharded serving plane: N independent shard units behind one front-end.
+
+ROADMAP item 1's horizontal-scale story, built as a *robustness* layer
+(ISSUE 9): :class:`ShardedReservoirService` fronts N fully independent
+:class:`~reservoir_tpu.serve.shard.ShardUnit` failure domains — engine +
+bridge + journal/checkpoint directory + epoch fence + optional hot
+standby each — so one demoted, wedged, or fenced shard degrades exactly
+``1/N`` of the key space while every other shard keeps serving.
+
+Design points:
+
+- **deterministic routing** — ``shard_of(key) = crc32(routing_epoch:key)
+  % n_shards``: a stable hash with a pinned *routing epoch*, the
+  split-by-hash discipline of Sanders et al.'s SIMD stream partitioning
+  (arXiv:1610.05141) applied at session granularity.  The header of
+  ``routing.jsonl`` journals ``(n_shards, routing_epoch, key)`` and every
+  open appends a ``route`` record, so :meth:`recover` provably re-routes
+  identically (each replayed record is cross-checked against the hash;
+  a torn tail — crash mid-append — is dropped, same tolerance as every
+  other journal in the stack).
+- **per-shard admission and partial degradation** — a saturated shard's
+  :class:`~reservoir_tpu.errors.ServiceSaturated` already only rejects
+  its own sessions; a fenced or killed shard rejects with the new
+  :class:`~reservoir_tpu.errors.ShardUnavailable` (a ``ServiceSaturated``
+  subclass carrying ``shard`` + ``retry_after_s``), and nothing routed
+  elsewhere notices.  The ``shard.route`` fault site fires on every
+  resolution; injected failures surface as typed per-call
+  :class:`~reservoir_tpu.errors.SessionIngestError` — the routing table
+  and the cluster stay live.
+- **cluster health over shard-scoped HA** — each unit runs the PR-5
+  heartbeat/controller loop against its own directory; :meth:`beat`
+  aggregates the per-shard beats into ONE cluster ``heartbeat.json``
+  (per-shard epoch/seq/lag/SLO rows + the worst verdict) that
+  ``tools/reservoir_top.py`` renders as a per-shard panel.
+- **cross-shard merged snapshots** — *Parallel Streaming Random
+  Sampling* (arXiv:1906.04120) makes per-shard reservoirs mergeable into
+  one logical sample; :meth:`merged_snapshot` reads each named session
+  at its shard and merges with
+  :func:`~reservoir_tpu.parallel.merge.merge_samples_host` — the exact
+  hypergeometric pairwise merge in a deterministic log-depth tree, so
+  the result bit-reconciles with a single-shard oracle merging the same
+  per-session oracle replays (pinned by ``tests/test_cluster.py``).
+
+Single-writer like everything below: one thread drives the cluster.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+import zlib
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..config import SamplerConfig
+from ..errors import (
+    FencedError,
+    SessionIngestError,
+    ShardUnavailable,
+)
+from ..obs import registry as _obs
+from ..utils import faults as _faults
+from .service import ReservoirService
+from .shard import ShardUnit
+
+__all__ = ["ShardedReservoirService", "shard_of"]
+
+_ROUTING_NAME = "routing.jsonl"
+_ROUTING_VERSION = 1
+_HEARTBEAT_NAME = "heartbeat.json"
+
+#: Verdict severity order shared with the SLO plane.
+_SEVERITY = {"ok": 0, "warn": 1, "page": 2}
+
+
+def shard_of(key: str, n_shards: int, routing_epoch: int = 0) -> int:
+    """The deterministic session->shard route: a stable 32-bit hash of
+    ``routing_epoch:key`` mod ``n_shards``.  Pure function — recovery,
+    standbys, and external routers all agree by construction; bumping
+    ``routing_epoch`` re-deals the whole key space (the future live-
+    resharding lever of ROADMAP item 2)."""
+    h = zlib.crc32(f"{routing_epoch}:{key}".encode("utf-8"))
+    return h % int(n_shards)
+
+
+class ShardedReservoirService:
+    """N independent shard units behind one session-keyed front-end.
+
+    The public surface mirrors :class:`ReservoirService` — open/ingest/
+    snapshot/close/sync — so traffic harnesses (``tools/loadgen.py``)
+    drive a cluster unchanged; each call routes to exactly one shard and
+    fails (typed, with ``retry_after_s``) only with that shard.
+
+    Args:
+      config: PER-SHARD engine config (total capacity =
+        ``n_shards * config.num_reservoirs``).
+      n_shards: shard count (pinned in the routing journal).
+      cluster_dir: the cluster's root directory; shard ``i`` owns
+        ``<cluster_dir>/shard<i>`` and the cluster itself journals
+        routing (``routing.jsonl``) and aggregates health
+        (``heartbeat.json``) here.
+      key: base engine seed; shard ``i`` seeds its engine with
+        ``key + 7919 * i`` (distinct, deterministic, replayable — kept on
+        each unit's ``engine_seed`` for oracle replays).
+      routing_epoch: the pinned routing-epoch of :func:`shard_of`.
+      standby: run a hot standby + failover controller per shard.
+      retry_after_s: the retry hint a down shard's
+        :class:`ShardUnavailable` carries.
+      faults: fault plane reaching the cluster's ``shard.*`` sites and
+        every unit's lower-layer sites.
+      **shard_kwargs: forwarded to every :class:`ShardUnit` (and through
+        it to each :class:`ReservoirService`): ``ttl_s``, ``gated``,
+        ``coalesce_bytes``, ``durability``, ``heartbeat_timeout_s``, ...
+    """
+
+    def __init__(
+        self,
+        config: SamplerConfig,
+        n_shards: int,
+        cluster_dir: str,
+        *,
+        key: int = 0,
+        routing_epoch: int = 0,
+        standby: bool = True,
+        retry_after_s: float = 0.05,
+        faults: Optional[Any] = None,
+        _units: Optional[List[ShardUnit]] = None,
+        **shard_kwargs: Any,
+    ) -> None:
+        if n_shards < 1:
+            raise ValueError("n_shards must be positive")
+        self._config = config
+        self.n_shards = int(n_shards)
+        self.cluster_dir = cluster_dir
+        self.routing_epoch = int(routing_epoch)
+        self._base_key = int(key)
+        self._retry_after_s = float(retry_after_s)
+        self._faults = faults
+        os.makedirs(cluster_dir, exist_ok=True)
+        if _units is not None:
+            self._units = _units
+            self._routing_fh = open(
+                os.path.join(cluster_dir, _ROUTING_NAME),
+                "a",
+                encoding="utf-8",
+            )
+        else:
+            self._units = [
+                ShardUnit(
+                    config,
+                    i,
+                    self.shard_dir(i),
+                    key=self.shard_seed(i),
+                    standby=standby,
+                    faults=faults,
+                    **shard_kwargs,
+                )
+                for i in range(self.n_shards)
+            ]
+            self._routing_fh = open(
+                os.path.join(cluster_dir, _ROUTING_NAME),
+                "w",
+                encoding="utf-8",
+            )
+            self._append_routing(
+                {
+                    "op": "base",
+                    "v": _ROUTING_VERSION,
+                    "shards": self.n_shards,
+                    "routing_epoch": self.routing_epoch,
+                    "key": self._base_key,
+                }
+            )
+
+    # ------------------------------------------------------------ structure
+
+    def shard_dir(self, shard: int) -> str:
+        return os.path.join(self.cluster_dir, f"shard{int(shard)}")
+
+    def shard_seed(self, shard: int) -> int:
+        """Shard ``i``'s engine seed: distinct per shard, derived from the
+        cluster base key deterministically (oracle replays re-derive it)."""
+        return self._base_key + 7919 * int(shard)
+
+    @property
+    def config(self) -> SamplerConfig:
+        return self._config
+
+    @property
+    def units(self) -> List[ShardUnit]:
+        return self._units
+
+    def unit(self, shard: int) -> ShardUnit:
+        return self._units[int(shard)]
+
+    def _append_routing(self, rec: dict) -> None:
+        self._routing_fh.write(json.dumps(rec) + "\n")
+        self._routing_fh.flush()
+
+    # -------------------------------------------------------------- routing
+
+    def shard_of(self, key: str) -> int:
+        """Resolve ``key``'s shard (pure — no fault site, no journal)."""
+        return shard_of(key, self.n_shards, self.routing_epoch)
+
+    def _route(self, key: str) -> Tuple[ShardUnit, int]:
+        """The serving-path resolution: fires the ``shard.route`` fault
+        site (injected failures surface as a typed per-call
+        :class:`SessionIngestError` — the cluster stays live) and turns a
+        down shard into :class:`ShardUnavailable` scoped to it."""
+        try:
+            _faults.fire("shard.route", self._faults)
+        except Exception as e:
+            raise SessionIngestError(
+                key, f"shard routing failed: {type(e).__name__}: {e}"
+            ) from e
+        shard = self.shard_of(key)
+        unit = self._units[shard]
+        if not unit.alive:
+            raise ShardUnavailable(
+                f"session {key!r} routes to shard {shard}, which is "
+                f"{unit.unavailable_reason or 'unavailable'}; retry after "
+                "failover/recovery completes",
+                retry_after_s=self._retry_after_s,
+                shard=shard,
+                reason=unit.unavailable_reason or "unavailable",
+            )
+        return unit, shard
+
+    def _guard(self, unit: ShardUnit, shard: int, exc: FencedError):
+        """A delegated call hit the shard's fence mid-flight: the primary
+        is a zombie (a standby was promoted, or a chaos fence landed).
+        Mark the shard down and re-raise scoped — every other shard is
+        untouched."""
+        unit.mark_fenced()
+        raise ShardUnavailable(
+            f"shard {shard} primary is fenced (epoch "
+            f"{exc.observed_epoch} > {exc.own_epoch}); promote its standby "
+            "or recover it",
+            retry_after_s=self._retry_after_s,
+            shard=shard,
+            reason="fenced",
+        ) from exc
+
+    # ------------------------------------------------------------- sessions
+
+    def open_session(self, key: str):
+        """Lease ``key`` on its (deterministic) shard; the route is
+        journaled so recovery re-routes identically."""
+        unit, shard = self._route(key)
+        try:
+            sess = unit.service.open_session(key)
+        except FencedError as e:
+            self._guard(unit, shard, e)
+        self._append_routing({"op": "route", "key": key, "shard": shard})
+        _obs.emit(
+            "shard.route", site="shard.route", session=key, shard=shard
+        )
+        return sess
+
+    def ingest(self, key: str, elements: Any, weights: Optional[Any] = None) -> int:
+        unit, shard = self._route(key)
+        try:
+            return unit.service.ingest(key, elements, weights)
+        except FencedError as e:
+            self._guard(unit, shard, e)
+
+    def snapshot(self, key: str, sync: bool = True) -> np.ndarray:
+        unit, shard = self._route(key)
+        try:
+            return unit.service.snapshot(key, sync=sync)
+        except FencedError as e:
+            self._guard(unit, shard, e)
+
+    def close_session(self, key: str) -> np.ndarray:
+        unit, shard = self._route(key)
+        try:
+            return unit.service.close_session(key)
+        except FencedError as e:
+            self._guard(unit, shard, e)
+
+    def sync(self) -> Dict[int, int]:
+        """Barrier every LIVE shard; returns ``{shard: flushed_seq}``.
+        A shard hitting its fence mid-sync is marked down and skipped —
+        partial degradation, not a cluster-wide failure."""
+        seqs: Dict[int, int] = {}
+        for unit in self._units:
+            if not unit.alive:
+                continue
+            try:
+                seqs[unit.shard_id] = unit.service.sync()
+            except FencedError:
+                unit.mark_fenced()
+        return seqs
+
+    def sessions_open(self) -> int:
+        return sum(
+            len(u.service.table) for u in self._units if u.alive
+        )
+
+    # ------------------------------------------------------------ HA plane
+
+    def poll(self) -> int:
+        """One replication step on every shard's standby; returns total
+        sequences advanced."""
+        return sum(unit.poll() for unit in self._units)
+
+    def health(self) -> Dict[int, Any]:
+        """Per-shard controller verdicts (shards without standbys omitted)."""
+        out = {}
+        for unit in self._units:
+            report = unit.health()
+            if report is not None:
+                out[unit.shard_id] = report
+        return out
+
+    def maybe_promote(self) -> List[Tuple[int, str]]:
+        """One cluster control-loop step: promote every shard whose OWN
+        health verdict says so; returns ``[(shard, reason), ...]``."""
+        promoted = []
+        for unit in self._units:
+            report = unit.health()
+            if report is None or not report.should_promote:
+                continue
+            unit.promote(
+                reason="; ".join(report.reasons) or "unhealthy",
+                triggers=report.triggers,
+            )
+            promoted.append((unit.shard_id, ",".join(report.triggers)))
+        return promoted
+
+    def kill_shard(self, shard: int):
+        return self._units[int(shard)].kill()
+
+    def fence_shard(self, shard: int) -> int:
+        return self._units[int(shard)].fence()
+
+    def promote_shard(self, shard: int, reason: str = "manual"):
+        return self._units[int(shard)].promote(reason=reason)
+
+    def recover_shard(self, shard: int, **kwargs):
+        return self._units[int(shard)].recover(**kwargs)
+
+    def beat(self) -> dict:
+        """Beat every live shard, then aggregate ONE cluster heartbeat
+        (``<cluster_dir>/heartbeat.json``, atomic): per-shard
+        epoch/seq/lag/SLO rows plus the worst verdict — what
+        ``tools/reservoir_top.py`` renders as the per-shard panel.  A
+        shard whose beacon fails (fenced zombie, injected fault) is
+        recorded down, never skipped silently."""
+        shards: Dict[str, dict] = {}
+        worst = "ok"
+        for unit in self._units:
+            try:
+                unit.beat()
+                row = unit.status()
+            except Exception as e:  # fenced/faulted beacon: the row says so
+                row = unit.status()
+                row["beat_error"] = f"{type(e).__name__}: {e}"
+            if not row.get("alive"):
+                worst = "page"
+            worst = max(
+                (worst, row.get("slo_worst", "ok")),
+                key=lambda v: _SEVERITY.get(v, 0),
+            )
+            shards[str(unit.shard_id)] = row
+        payload = {
+            "ts": time.time(),
+            "cluster": True,
+            "n_shards": self.n_shards,
+            "routing_epoch": self.routing_epoch,
+            "sessions_open": self.sessions_open(),
+            "worst": worst,
+            "shards": shards,
+        }
+        import tempfile
+
+        fd, tmp = tempfile.mkstemp(dir=self.cluster_dir, suffix=".tmp.hb")
+        try:
+            with os.fdopen(fd, "w", encoding="utf-8") as fh:
+                json.dump(payload, fh)
+            os.replace(tmp, os.path.join(self.cluster_dir, _HEARTBEAT_NAME))
+        except BaseException:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+            raise
+        return payload
+
+    # ------------------------------------------------------ merged snapshots
+
+    def merged_snapshot(
+        self, keys: Sequence[str], *, merge_key: int = 0, sync: bool = True
+    ) -> np.ndarray:
+        """One logical uniform sample over the named sessions' combined
+        streams, merged across shards with the exact mergeable-reservoir
+        math (arXiv:1906.04120 via
+        :func:`~reservoir_tpu.parallel.merge.merge_samples_host`).
+        Deterministic for a fixed ``merge_key`` and key order, and
+        bit-reconcilable with a single-shard oracle merging per-session
+        oracle replays with the same function.  Uniform (plain) mode
+        only — weighted/distinct merges are state-keyed and ride the mesh
+        mergers in :mod:`reservoir_tpu.parallel.merge`."""
+        if self._config.weighted or self._config.distinct:
+            raise ValueError(
+                "merged_snapshot is uniform-mode only: weighted/distinct "
+                "merges need state-level keys (ES keys / hash planes); use "
+                "the mesh mergers in reservoir_tpu.parallel.merge"
+            )
+        if not keys:
+            raise ValueError("merged_snapshot needs at least one session key")
+        from ..parallel.merge import merge_samples_host
+
+        reg = _obs.get()
+        t0 = time.perf_counter() if reg is not None else 0.0
+        parts = []
+        for key in keys:
+            unit, _ = self._route(key)
+            sample = unit.service.snapshot(key, sync=sync)
+            parts.append((sample, unit.service.table.route(key).elements))
+        merged, _total = merge_samples_host(
+            parts, merge_key, max_sample_size=self._config.max_sample_size
+        )
+        if reg is not None:
+            reg.histogram("cluster.merge_s").observe(
+                time.perf_counter() - t0
+            )
+        return merged
+
+    # -------------------------------------------------------------- recovery
+
+    @classmethod
+    def recover(
+        cls,
+        cluster_dir: str,
+        *,
+        standby: bool = True,
+        retry_after_s: float = 0.05,
+        faults: Optional[Any] = None,
+        **shard_kwargs: Any,
+    ) -> "ShardedReservoirService":
+        """Rebuild a crashed cluster from ``cluster_dir``.
+
+        The routing journal's header re-pins ``(n_shards, routing_epoch,
+        key)`` — the entire routing function — so every session re-routes
+        identically; each replayed ``route`` record is cross-checked
+        against the hash (divergence is a hard error, it would strand
+        sessions on the wrong shard) and a torn final line is dropped
+        (crash mid-append: the open it described is re-journaled by the
+        shard's own session journal or never happened).  Each shard then
+        recovers independently via :meth:`ReservoirService.recover` —
+        including the ISSUE-9 epoch pre-flight, so a shard whose lineage
+        was fenced by a promotion fails typed instead of double-serving."""
+        path = os.path.join(cluster_dir, _ROUTING_NAME)
+        with open(path, encoding="utf-8") as fh:
+            lines = fh.read().splitlines()
+        records: List[dict] = []
+        for i, line in enumerate(lines):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                records.append(json.loads(line))
+            except json.JSONDecodeError:
+                if i == len(lines) - 1:
+                    break  # torn tail: crash mid-append, dropped
+                raise ValueError(
+                    f"{path!r}: corrupt routing journal at line {i + 1}"
+                )
+        if not records or records[0].get("op") != "base":
+            raise ValueError(
+                f"{path!r}: routing journal has no base header record"
+            )
+        header = records[0]
+        n_shards = int(header["shards"])
+        routing_epoch = int(header["routing_epoch"])
+        base_key = int(header["key"])
+        for rec in records[1:]:
+            if rec.get("op") != "route":
+                raise ValueError(
+                    f"routing journal: unknown op {rec.get('op')!r}"
+                )
+            want = shard_of(rec["key"], n_shards, routing_epoch)
+            if int(rec["shard"]) != want:
+                raise ValueError(
+                    f"routing journal replay diverged at {rec!r}: the "
+                    f"pinned routing function routes {rec['key']!r} to "
+                    f"shard {want}"
+                )
+        units = []
+        for i in range(n_shards):
+            shard_dir = os.path.join(cluster_dir, f"shard{i}")
+            service = ReservoirService.recover(
+                shard_dir,
+                obs_scope=f"shard{i}",
+                faults=faults,
+                **{
+                    k: v
+                    for k, v in shard_kwargs.items()
+                    if k in (
+                        "ttl_s", "coalesce_bytes", "max_inflight_bytes",
+                        "retry_after_s", "sweep_interval_s", "auditor",
+                        "retry_policy", "flush_timeout_s",
+                        "checkpoint_every", "durability", "pipelined",
+                    )
+                },
+            )
+            units.append(
+                ShardUnit(
+                    service.config,
+                    i,
+                    shard_dir,
+                    key=base_key + 7919 * i,
+                    standby=standby,
+                    faults=faults,
+                    _service=service,
+                    **shard_kwargs,
+                )
+            )
+        return cls(
+            units[0].service.config,
+            n_shards,
+            cluster_dir,
+            key=base_key,
+            routing_epoch=routing_epoch,
+            standby=standby,
+            retry_after_s=retry_after_s,
+            faults=faults,
+            _units=units,
+        )
+
+    # -------------------------------------------------------------- teardown
+
+    def metrics_snapshot(self) -> dict:
+        """Per-shard metric blocks plus cluster totals (bench evidence)."""
+        shards = {
+            str(u.shard_id): (
+                u.service.metrics.snapshot() if u.alive else None
+            )
+            for u in self._units
+        }
+        live = [u.service.metrics for u in self._units if u.alive]
+        return {
+            "shards": shards,
+            "ingested_elements": sum(m.ingested_elements for m in live),
+            "rejections": sum(m.rejections for m in live),
+            "sessions_open": self.sessions_open(),
+        }
+
+    def shutdown(self) -> None:
+        for unit in self._units:
+            if unit.alive:
+                unit.shutdown()
+        if self._routing_fh is not None:
+            self._routing_fh.close()
+            self._routing_fh = None
+
+    def __del__(self) -> None:
+        fh = getattr(self, "_routing_fh", None)
+        if fh is not None:
+            try:
+                fh.close()
+            except OSError:
+                pass

@@ -38,7 +38,7 @@ import json
 import os
 import tempfile
 import time
-from typing import Any, List, Optional
+from typing import Any, List, Optional, Tuple
 
 from ..errors import FencedError
 from ..obs import registry as _obs
@@ -171,13 +171,22 @@ class HealthReport:
     """One controller verdict.  ``should_promote`` is the actionable bit;
     ``reasons`` name every signal that contributed (promote-worthy ones
     first), ``heartbeat_age_s`` the observed staleness (``None`` before
-    the first check can age anything)."""
+    the first check can age anything).
+
+    ``triggers`` (ISSUE-9 satellite) is the machine-readable companion of
+    ``reasons``: one stable tag per contributing signal, in the same
+    order — ``staleness`` / ``watchdog`` / ``demotions`` / ``rejections``
+    for the promote-worthy ones, then ``slo_worst`` (and degraded-only
+    ``demotions``/``rejections``/``heartbeat_read``) — so a chaos-soak
+    failure or a promotion audit names its trigger without parsing the
+    human strings."""
 
     healthy: bool
     should_promote: bool
     reasons: List[str]
     heartbeat_age_s: Optional[float]
     heartbeat: Optional[dict]
+    triggers: List[str] = dataclasses.field(default_factory=list)
 
 
 class FailoverController:
@@ -222,73 +231,89 @@ class FailoverController:
         self._metrics = standby.metrics
         self._first_check_t: Optional[float] = None
         self.last_promotion_reason: Optional[str] = None
+        self.last_promotion_triggers: List[str] = []
 
     @property
     def metrics(self) -> HAMetrics:
         return self._metrics
 
     def health(self) -> HealthReport:
-        """Evaluate the primary's health from its emitted signals."""
+        """Evaluate the primary's health from its emitted signals.  Every
+        reason string is paired with a stable trigger tag
+        (:attr:`HealthReport.triggers`), promote-worthy signals first."""
         now = self._clock()
         if self._first_check_t is None:
             self._first_check_t = now
-        promote: List[str] = []
-        degraded: List[str] = []
+        promote: List[Tuple[str, str]] = []  # (trigger, reason)
+        degraded: List[Tuple[str, str]] = []
         hb: Optional[dict] = None
         try:
             _faults.fire("ha.heartbeat", self._faults)
             hb = read_heartbeat(self._dir)
         except Exception as e:
-            degraded.append(
-                f"heartbeat read failed ({type(e).__name__}: {e})"
-            )
+            degraded.append((
+                "heartbeat_read",
+                f"heartbeat read failed ({type(e).__name__}: {e})",
+            ))
         if hb is None:
             age = now - self._first_check_t
             if age > self._timeout:
-                promote.append(
+                promote.append((
+                    "staleness",
                     f"no heartbeat for {age:.1f}s "
-                    f"(timeout {self._timeout:g}s)"
-                )
+                    f"(timeout {self._timeout:g}s)",
+                ))
         else:
             age = now - float(hb.get("ts", 0.0))
             if age > self._timeout:
-                promote.append(
-                    f"heartbeat stale ({age:.1f}s > {self._timeout:g}s)"
-                )
+                promote.append((
+                    "staleness",
+                    f"heartbeat stale ({age:.1f}s > {self._timeout:g}s)",
+                ))
             trips = int(hb.get("watchdog_trips", 0))
             if trips > self._max_watchdog:
-                promote.append(
-                    f"flush watchdog tripped {trips}x (pipeline wedged)"
-                )
+                promote.append((
+                    "watchdog",
+                    f"flush watchdog tripped {trips}x (pipeline wedged)",
+                ))
             demotions = int(hb.get("demotions", 0))
             if self._max_demotions is not None and (
                 demotions > self._max_demotions
             ):
-                promote.append(f"{demotions} Pallas->XLA demotions")
+                promote.append(
+                    ("demotions", f"{demotions} Pallas->XLA demotions")
+                )
             elif demotions:
-                degraded.append(f"degraded: {demotions} demotions")
+                degraded.append(
+                    ("demotions", f"degraded: {demotions} demotions")
+                )
             rejections = int(hb.get("rejections", 0))
             if self._max_rejections is not None and (
                 rejections > self._max_rejections
             ):
-                promote.append(
-                    f"{rejections} admission rejections (saturated)"
-                )
+                promote.append((
+                    "rejections",
+                    f"{rejections} admission rejections (saturated)",
+                ))
             elif rejections:
-                degraded.append(f"degraded: {rejections} rejections")
+                degraded.append(
+                    ("rejections", f"degraded: {rejections} rejections")
+                )
             worst = hb.get("slo_worst")
             if worst in ("warn", "page"):
                 # burn-rate verdicts (ISSUE 7) are health signals, never
                 # promote triggers on their own: a slow-but-alive primary
                 # is still a primary (same posture as demotions), and a
                 # failover would not fix a biased sampler anyway
-                degraded.append(f"degraded: SLO {worst}")
+                degraded.append(("slo_worst", f"degraded: SLO {worst}"))
+        signals = promote + degraded
         return HealthReport(
-            healthy=not promote and not degraded,
+            healthy=not signals,
             should_promote=bool(promote),
-            reasons=promote + degraded,
+            reasons=[r for _, r in signals],
             heartbeat_age_s=age,
             heartbeat=hb,
+            triggers=[t for t, _ in signals],
         )
 
     def maybe_promote(self) -> Optional[Any]:
@@ -298,12 +323,27 @@ class FailoverController:
         report = self.health()
         if not report.should_promote:
             return None
-        return self.promote(reason="; ".join(report.reasons) or "unhealthy")
+        return self.promote(
+            reason="; ".join(report.reasons) or "unhealthy",
+            triggers=report.triggers,
+        )
 
-    def promote(self, reason: str = "manual") -> Any:
+    def promote(
+        self, reason: str = "manual", triggers: Optional[List[str]] = None
+    ) -> Any:
         """Force the failover (epoch fence + tail drain + flip); returns
         the promoted service.  ``promotions`` counts on the shared
-        metrics (inside ``StandbyReplica.promote``)."""
+        metrics (inside ``StandbyReplica.promote``).  The promotion event
+        record (``ha.promote_decision``, ISSUE-9 satellite) names the
+        trigger tags alongside the human reason, so a chaos-soak failure
+        can say *which* signal pulled the trigger."""
         service = self._standby.promote()
         self.last_promotion_reason = reason
+        self.last_promotion_triggers = list(triggers or [])
+        _obs.emit(
+            "ha.promote_decision",
+            site="ha.promote",
+            reason=reason,
+            triggers=",".join(self.last_promotion_triggers) or "manual",
+        )
         return service

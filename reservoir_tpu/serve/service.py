@@ -130,6 +130,13 @@ class ReservoirService:
         ``audit.*`` instruments the ``sample_quality`` SLO judges.  Both
         hooks are zero-overhead no-ops while telemetry is disabled
         (pinned by the trip-wire in ``tests/test_obs.py``).
+      obs_scope: per-shard instrument label (ISSUE 9).  When set, the
+        service's ``serve.*`` instruments are recorded under scoped names
+        (``serve.ingest_s@<scope>`` — :func:`reservoir_tpu.obs.registry.scoped`)
+        so N shard services sharing one registry stay separately
+        observable and separately SLO-judged
+        (``default_slos(scope=...)``).  ``None`` (default) keeps the
+        unscoped names every existing dashboard reads.
       pipelined / retry_policy / flush_timeout_s / checkpoint_dir /
         checkpoint_every / durability / faults / gated / gate_tile:
         forwarded to the underlying :class:`DeviceStreamBridge` (the
@@ -158,6 +165,7 @@ class ReservoirService:
         retry_after_s: float = 0.05,
         sweep_interval_s: Optional[float] = None,
         auditor: Optional[Any] = None,
+        obs_scope: Optional[str] = None,
         pipelined: bool = True,
         retry_policy: Optional[RetryPolicy] = None,
         flush_timeout_s: Optional[float] = None,
@@ -207,6 +215,7 @@ class ReservoirService:
             float(sweep_interval_s) if sweep_interval_s is not None else None
         )
         self._auditor = auditor
+        self._obs_scope = obs_scope
         self._last_sweep = self._table._clock()
         self._metrics = ServiceMetrics()
         self._metrics.sessions_open = len(self._table)
@@ -265,6 +274,11 @@ class ReservoirService:
     def flushed_seq(self) -> int:
         """The underlying bridge's durable flush watermark."""
         return self._bridge.flushed_seq
+
+    def _scoped(self, name: str) -> str:
+        """Instrument name under this service's per-shard scope (ISSUE 9);
+        the unscoped name when the service is not shard-labeled."""
+        return _obs.scoped(name, self._obs_scope)
 
     def _append_journal(self, rec: dict) -> None:
         if self._journal_fh is None:
@@ -419,12 +433,14 @@ class ReservoirService:
             n = self._ingest_impl(key, elements, weights)
         except (SessionIngestError, ServiceSaturated):
             if reg is not None:
-                reg.counter("serve.ingest_total").inc()
-                reg.counter("serve.ingest_errors").inc()
+                reg.counter(self._scoped("serve.ingest_total")).inc()
+                reg.counter(self._scoped("serve.ingest_errors")).inc()
             raise
         if reg is not None:
-            reg.counter("serve.ingest_total").inc()
-            reg.histogram("serve.ingest_s").observe(time.perf_counter() - t0)
+            reg.counter(self._scoped("serve.ingest_total")).inc()
+            reg.histogram(self._scoped("serve.ingest_s")).observe(
+                time.perf_counter() - t0
+            )
         return n
 
     def _ingest_impl(
@@ -533,7 +549,7 @@ class ReservoirService:
             # when it shipped (1.0 = exactly at threshold; < 1.0 = a
             # barrier flushed it early) — the `coalesce_bytes` tuning lever
             reg.histogram(
-                "serve.coalesce_fill", lo=1e-3, hi=10.0
+                self._scoped("serve.coalesce_fill"), lo=1e-3, hi=10.0
             ).observe(self._pend_bytes / self._coalesce_bytes)
         pend, self._pend, self._pend_bytes = self._pend, [], 0
         streams = np.concatenate([p[0] for p in pend])
@@ -609,13 +625,15 @@ class ReservoirService:
             # population than the live cache-read path; keep the two
             # histograms separate so `snapshot_p*` stays the live number
             reg.histogram(
-                "serve.snapshot_sync_s" if sync else "serve.snapshot_s"
+                self._scoped(
+                    "serve.snapshot_sync_s" if sync else "serve.snapshot_s"
+                )
             ).observe(time.perf_counter() - t0)
             # staleness: age of the device->host snapshot this read was
             # served from (0-ish on a miss; grows while the cache serves)
-            reg.histogram("serve.snapshot_staleness_s").observe(
-                time.monotonic() - self._snap_at
-            )
+            reg.histogram(
+                self._scoped("serve.snapshot_staleness_s")
+            ).observe(time.monotonic() - self._snap_at)
         return out
 
     # ------------------------------------------------------------- recovery
@@ -631,6 +649,7 @@ class ReservoirService:
         retry_after_s: float = 0.05,
         sweep_interval_s: Optional[float] = None,
         auditor: Optional[Any] = None,
+        obs_scope: Optional[str] = None,
         pipelined: Optional[bool] = None,
         retry_policy: Optional[RetryPolicy] = None,
         flush_timeout_s: Optional[float] = None,
@@ -730,6 +749,7 @@ class ReservoirService:
             retry_after_s=retry_after_s,
             sweep_interval_s=sweep_interval_s,
             auditor=auditor,
+            obs_scope=obs_scope,
             faults=faults,
             checkpoint_dir=checkpoint_dir,
             _bridge=bridge,

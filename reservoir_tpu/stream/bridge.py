@@ -1365,6 +1365,7 @@ class DeviceStreamBridge:
             metadata={
                 "bridge": {
                     "seq": self._flush_seq,
+                    "epoch": self._epoch,
                     "reusable": self._reusable,
                     "pipelined": self._pipeline is not None,
                     "checkpoint_every": self._ckpt_every,
@@ -1460,6 +1461,26 @@ class DeviceStreamBridge:
             raise ValueError(
                 f"{engine_path!r} was not written by an auto-checkpointing "
                 "bridge (no bridge metadata); use ReservoirEngine.restore()"
+            )
+        # Recovery pre-flight (ISSUE-9 satellite): cross-check the epoch
+        # this checkpoint lineage was admitted at against the persisted
+        # fence BEFORE any replay.  A newer persisted epoch means a
+        # standby was promoted past this lineage — recovering it would
+        # put a second journaling writer on rows the promoted primary now
+        # owns.  Fail typed and immediately, not via a FencedError on the
+        # first post-recovery flush (or worse, silently adopting the new
+        # epoch).  Old checkpoints without the recorded epoch pre-date
+        # fencing promotions on their dir and pass vacuously.
+        from ..errors import CheckpointMismatch
+        persisted = read_epoch(checkpoint_dir)
+        recorded = int(info.get("epoch", persisted))
+        if persisted > recorded:
+            raise CheckpointMismatch(
+                f"{checkpoint_dir!r}: checkpoint lineage was admitted at "
+                f"primary epoch {recorded}, but the persisted fence is at "
+                f"epoch {persisted} — a standby was promoted past this "
+                "lineage; recover from the promoted primary's checkpoint "
+                "(its post-promotion handoff checkpoint) instead"
             )
         engine._faults = faults
         bridge = cls(

@@ -71,6 +71,11 @@ __all__ = [
 #: is retried bit-exactly on the next poll), and ``ha.heartbeat`` (the
 #: primary's heartbeat write and the controller's read — a failing writer
 #: goes stale and triggers promotion).
+#: The sharded serving plane (ISSUE 9) adds ``shard.route`` (the cluster's
+#: session->shard resolution — an injected failure surfaces as a typed
+#: per-call error, the routing table and every other shard stay live) and
+#: ``shard.promote`` (a shard unit's failover promotion — an injected
+#: failure leaves the standby un-promoted and re-promotable).
 SITES: Tuple[str, ...] = (
     "bridge.dispatch",
     "bridge.demux",
@@ -82,6 +87,8 @@ SITES: Tuple[str, ...] = (
     "replica.ship",
     "replica.apply",
     "ha.heartbeat",
+    "shard.route",
+    "shard.promote",
 )
 
 

@@ -100,6 +100,39 @@ def test_bench_traffic_row_reports_wait_staleness_and_slo_verdicts():
     assert "loadgen.wait_s" in stages["telemetry"]
 
 
+def test_bench_shards_row_reports_per_shard_failover_and_merge():
+    # the ISSUE-9 acceptance surface: `bench.py shards` must run the
+    # sharded cluster end-to-end on CPU and its row must carry the
+    # per-shard ingest rates, the kill-one-shard failover time, and the
+    # merged-snapshot quantiles — the stable column names watcher
+    # captures parse.  One rep: the row contract is shape, not
+    # statistics — keep the tier-1 budget lean
+    rec = _run_bench(
+        {"RESERVOIR_BENCH_CONFIG": "shards", "RESERVOIR_BENCH_REPS": "1"}
+    )
+    assert "shards_cluster_feed" in rec["metric"]
+    assert rec["value"] > 0
+    assert rec["shards"] >= 2
+    assert rec["failover_ms"] > 0
+    assert rec["merge_p99_ms"] > 0
+    stages = rec["stages"]
+    for col in (
+        "shards", "per_shard_rows", "sessions", "victim_shard", "elements",
+        "per_shard_elem_s", "failover_ms_best", "failover_ms_median",
+        "merge_p50_ms", "merge_p99_ms", "merges",
+    ):
+        assert col in stages, col
+    # every shard actually ingested (hash routing reached all of them)
+    rates = stages["per_shard_elem_s"]
+    assert len(rates) == stages["shards"]
+    assert all(v > 0 for v in rates.values())
+    assert stages["failover_ms_best"] <= stages["failover_ms_median"]
+    assert stages["merge_p50_ms"] <= stages["merge_p99_ms"]
+    assert stages["merges"] > 0
+    # telemetry sub-dict rides the row like serve/ha stages
+    assert "cluster.merge_s" in stages["telemetry"]
+
+
 def test_bench_gated_row_reports_ab_and_skip_fraction():
     # the ISSUE-8 acceptance surface: `bench.py gated` must run the
     # gated-vs-ungated A/B end-to-end on CPU with bit-identity asserted

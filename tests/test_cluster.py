@@ -1,0 +1,649 @@
+"""Sharded serving plane: routing, partial failure, merged snapshots, soak.
+
+ISSUE 9: the serving plane becomes N fully independent shard units behind
+deterministic hash routing — one demoted/wedged/fenced shard degrades
+exactly its own key slice while the rest keep serving.  This suite pins:
+
+- **routing** — ``shard_of`` is a pure pinned hash; the routing journal's
+  header re-pins the whole function so ``recover()`` re-routes
+  identically, route records are divergence-checked, and a torn tail
+  (crash mid-append) is dropped like every other journal's;
+- **partial failure** — a killed or fenced shard rejects only its own
+  sessions with a typed :class:`ShardUnavailable` carrying
+  ``retry_after_s`` + the shard id, everything else keeps serving, the
+  fenced zombie cannot mutate its journal, and promote/recover restore
+  the slice bit-exactly;
+- **merged snapshots** — cross-shard ``merged_snapshot`` bit-reconciles
+  with a single-shard oracle merging per-session oracle replays through
+  the same ``merge_samples_host`` tree;
+- **the ISSUE-9 acceptance soak** — >= 20 randomized
+  kill/fence/promote/recover cycles across the gated x ungated matrix,
+  under live ``tools/loadgen.py`` traffic, asserting per-session
+  bit-exactness against per-shard oracles, zero cross-shard
+  contamination after recycles, and that no healthy shard's SLO verdict
+  ever leaves ``ok`` while a neighbor is down.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from test_serve import _oracle_replay  # noqa: E402  (the per-session oracle)
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(_REPO, "tools"))
+import loadgen  # noqa: E402
+
+from reservoir_tpu import SamplerConfig, obs
+from reservoir_tpu.errors import (
+    FencedError,
+    SessionIngestError,
+    ShardUnavailable,
+    TransientDeviceError,
+    UnknownSessionError,
+)
+from reservoir_tpu.parallel.merge import merge_samples_host
+from reservoir_tpu.serve import ShardedReservoirService, shard_of
+from reservoir_tpu.utils import faults
+from reservoir_tpu.utils.faults import FaultPlane, FaultRule
+
+
+@pytest.fixture(autouse=True)
+def _clean_planes():
+    faults.uninstall()
+    yield
+    faults.uninstall()
+    obs.disable()
+
+
+def _cfg(mode="plain", **kw):
+    kw.setdefault("max_sample_size", 3)
+    kw.setdefault("num_reservoirs", 4)
+    kw.setdefault("tile_size", 8)
+    return SamplerConfig(
+        distinct=(mode == "distinct"), weighted=(mode == "weighted"), **kw
+    )
+
+
+def _journal_bytes(shard_dir: str) -> bytes:
+    path = os.path.join(shard_dir, "journal.bin")
+    return open(path, "rb").read() if os.path.exists(path) else b""
+
+
+def _key_for_shard(cluster, shard, prefix="k"):
+    """A fresh session key the pinned hash routes to ``shard``."""
+    for i in range(10_000):
+        key = f"{prefix}{i}"
+        if cluster.shard_of(key) == shard:
+            return key
+    raise AssertionError("no key found for shard")
+
+
+# ---------------------------------------------------------------- routing
+
+
+def test_routing_is_deterministic_pinned_and_journaled(tmp_path):
+    cfg = _cfg()
+    cluster = ShardedReservoirService(
+        cfg, 4, str(tmp_path / "cl"), key=3, routing_epoch=2
+    )
+    keys = [f"s{i}" for i in range(64)]
+    routes = {k: cluster.shard_of(k) for k in keys}
+    # pure function: module-level shard_of agrees, and every shard gets
+    # a share (64 keys over 4 shards — an empty shard would mean a
+    # degenerate hash)
+    for k, s in routes.items():
+        assert shard_of(k, 4, routing_epoch=2) == s
+    assert len(set(routes.values())) == 4
+    # a different routing epoch re-deals the space (the pinned epoch is
+    # load-bearing, not decorative)
+    assert any(
+        shard_of(k, 4, routing_epoch=3) != s for k, s in routes.items()
+    )
+    for k in keys[:8]:
+        cluster.open_session(k)
+        cluster.ingest(k, np.arange(16, dtype=np.int32))
+    cluster.sync()
+    # the journal header pins the routing function; route records match
+    with open(os.path.join(str(tmp_path / "cl"), "routing.jsonl")) as fh:
+        recs = [json.loads(line) for line in fh if line.strip()]
+    assert recs[0]["op"] == "base"
+    assert recs[0]["shards"] == 4 and recs[0]["routing_epoch"] == 2
+    assert {r["key"]: r["shard"] for r in recs[1:]} == {
+        k: routes[k] for k in keys[:8]
+    }
+    cluster.shutdown()
+
+
+def test_recover_re_routes_identically_and_tolerates_torn_tail(tmp_path):
+    cfg = _cfg()
+    cl_dir = str(tmp_path / "cl")
+    cluster = ShardedReservoirService(cfg, 3, cl_dir, key=11)
+    fed = {}
+    for i in range(6):
+        k = f"s{i}"
+        cluster.open_session(k)
+        fed[k] = (100 * (i + 1) + np.arange(20)).astype(np.int32)
+        cluster.ingest(k, fed[k])
+    cluster.sync()
+    want = {k: cluster.snapshot(k) for k in fed}
+    routes = {k: cluster.shard_of(k) for k in fed}
+    cluster.shutdown()
+    # torn routing tail: a crash mid-append leaves half a JSON line — the
+    # recovery pin of the ISSUE-9 satellite
+    with open(os.path.join(cl_dir, "routing.jsonl"), "a") as fh:
+        fh.write('{"op": "route", "key": "s9", "sh')
+    recovered = ShardedReservoirService.recover(cl_dir)
+    for k in fed:
+        assert recovered.shard_of(k) == routes[k]
+        np.testing.assert_array_equal(recovered.snapshot(k), want[k])
+    # and the recovered cluster keeps serving + journaling
+    recovered.ingest("s0", np.arange(8, dtype=np.int32))
+    recovered.sync()
+    recovered.shutdown()
+    # a diverging route record (wrong shard) is a hard error, not a
+    # silent re-deal — it would strand the session's reservoir
+    bad_dir = str(tmp_path / "bad")
+    cluster2 = ShardedReservoirService(cfg, 3, bad_dir, key=11)
+    cluster2.open_session("x1")
+    cluster2.sync()
+    cluster2.shutdown()
+    with open(os.path.join(bad_dir, "routing.jsonl"), "a") as fh:
+        wrong = (shard_of("x1", 3) + 1) % 3
+        fh.write(json.dumps({"op": "route", "key": "x1", "shard": wrong}))
+        fh.write("\n{\"op\": \"pad\"}\n")  # keep the bad record off the tail
+    with pytest.raises(ValueError, match="diverged|unknown op"):
+        ShardedReservoirService.recover(bad_dir)
+
+
+# ---------------------------------------------------------- partial failure
+
+
+def test_killed_shard_rejects_only_its_sessions(tmp_path):
+    cfg = _cfg()
+    cluster = ShardedReservoirService(
+        cfg, 3, str(tmp_path / "cl"), key=5, coalesce_bytes=64
+    )
+    keys = [f"s{i}" for i in range(9)]
+    for k in keys:
+        cluster.open_session(k)
+        cluster.ingest(k, np.arange(16, dtype=np.int32))
+    cluster.sync()
+    cluster.poll()
+    victim = cluster.shard_of(keys[0])
+    victims = [k for k in keys if cluster.shard_of(k) == victim]
+    others = [k for k in keys if cluster.shard_of(k) != victim]
+    assert others, "need survivors for the partial-degradation claim"
+    before = {k: cluster.snapshot(k) for k in keys}
+    zombie = cluster.kill_shard(victim)
+    # the victim's slice rejects typed, with the shard named and a retry
+    # hint — the ServiceSaturated contract, scoped to one failure domain
+    for k in victims:
+        with pytest.raises(ShardUnavailable) as ei:
+            cluster.ingest(k, np.arange(8, dtype=np.int32))
+        assert ei.value.shard == victim
+        assert ei.value.retry_after_s > 0
+        assert ei.value.reason == "killed"
+    # every other shard serves reads AND writes, unperturbed
+    for k in others:
+        cluster.ingest(k, np.arange(8, dtype=np.int32))
+        assert cluster.snapshot(k).size > 0
+    # promote the victim's hot standby: the slice comes back bit-exact
+    # at the durable watermark, and the zombie is fenced out — its probe
+    # leaves the shard's (freshly rotated) journal untouched
+    cluster.promote_shard(victim, reason="chaos kill")
+    journal_before = _journal_bytes(cluster.shard_dir(victim))
+    with pytest.raises(FencedError):
+        zombie.sync()
+    with pytest.raises(FencedError):
+        zombie.ingest(victims[0], np.arange(64, dtype=np.int32))
+        zombie.sync()
+    assert _journal_bytes(cluster.shard_dir(victim)) == journal_before
+    assert zombie.bridge.metrics.fenced_writes >= 1
+    for k in victims:
+        np.testing.assert_array_equal(cluster.snapshot(k), before[k])
+        cluster.ingest(k, np.arange(8, dtype=np.int32))  # serving again
+    cluster.shutdown()
+
+
+def test_fenced_shard_marks_down_scoped_and_recovers_by_promotion(tmp_path):
+    cfg = _cfg()
+    cluster = ShardedReservoirService(
+        cfg, 2, str(tmp_path / "cl"), key=9, coalesce_bytes=64
+    )
+    a = _key_for_shard(cluster, 0, "a")
+    b = _key_for_shard(cluster, 1, "b")
+    for k in (a, b):
+        cluster.open_session(k)
+        cluster.ingest(k, np.arange(24, dtype=np.int32))
+    cluster.sync()
+    cluster.poll()
+    want_a = cluster.snapshot(a)
+    cluster.fence_shard(0)
+    # the fenced primary trips on its next durable write; the cluster
+    # scopes the failure to shard 0 and marks it down
+    with pytest.raises(ShardUnavailable) as ei:
+        cluster.ingest(a, np.arange(64, dtype=np.int32))
+    assert ei.value.shard == 0 and ei.value.reason == "fenced"
+    assert not cluster.unit(0).alive
+    cluster.ingest(b, np.arange(8, dtype=np.int32))  # shard 1 unbothered
+    # sync() degrades partially: live shards barrier, the fenced one is
+    # skipped (already marked), never a cluster-wide raise
+    seqs = cluster.sync()
+    assert 1 in seqs and 0 not in seqs
+    cluster.promote_shard(0, reason="fence trip")
+    np.testing.assert_array_equal(cluster.snapshot(a), want_a)
+    cluster.shutdown()
+
+
+def test_killed_shard_recovers_in_place_bit_exactly(tmp_path):
+    # the no-standby path: kill, then stop-the-world recover() from the
+    # shard's own directory (epoch unchanged -> the ISSUE-9 pre-flight
+    # passes); the slice comes back bit-exact at the durable watermark
+    cfg = _cfg()
+    cluster = ShardedReservoirService(
+        cfg, 2, str(tmp_path / "cl"), key=13, standby=False,
+        coalesce_bytes=64,
+    )
+    k0 = _key_for_shard(cluster, 0, "r")
+    cluster.open_session(k0)
+    cluster.ingest(k0, np.arange(30, dtype=np.int32))
+    cluster.sync()
+    want = cluster.snapshot(k0)
+    cluster.kill_shard(0)
+    with pytest.raises(ShardUnavailable):
+        cluster.snapshot(k0)
+    assert cluster.unit(0).standby is None
+    cluster.recover_shard(0)
+    np.testing.assert_array_equal(cluster.snapshot(k0), want)
+    cluster.shutdown()
+
+
+# --------------------------------------------------------- merged snapshots
+
+
+def test_merged_snapshot_reconciles_with_single_shard_oracle(tmp_path):
+    """Cross-shard merged snapshots (arXiv:1906.04120's mergeability):
+    merging the per-shard LIVE snapshots must bit-match merging the
+    per-session ORACLE replays through the same deterministic tree —
+    i.e. the cluster's merge is exactly the single-shard math applied to
+    exactly the per-shard samples."""
+    cfg = _cfg()
+    cluster = ShardedReservoirService(
+        cfg, 3, str(tmp_path / "cl"), key=21, coalesce_bytes=64
+    )
+    rng = np.random.default_rng(0)
+    keys, fed = [], {}
+    for i in range(6):
+        k = f"m{i}"
+        keys.append(k)
+        cluster.open_session(k)
+        fed[k] = rng.integers(0, 1 << 20, 10 + 5 * i).astype(np.int32)
+        cluster.ingest(k, fed[k])
+    cluster.sync()
+    assert len({cluster.shard_of(k) for k in keys}) > 1  # truly cross-shard
+    got = cluster.merged_snapshot(keys, merge_key=17)
+    parts = []
+    for k in keys:
+        unit = cluster.unit(cluster.shard_of(k))
+        sess = unit.table.route(k)
+        oracle = _oracle_replay(
+            cfg, unit.engine_seed, unit.table, sess, fed[k]
+        )
+        parts.append((oracle, len(fed[k])))
+    want, total = merge_samples_host(
+        parts, 17, max_sample_size=cfg.max_sample_size
+    )
+    assert total == sum(len(v) for v in fed.values())
+    np.testing.assert_array_equal(got, want)
+    # deterministic: same key, same order, same bits
+    np.testing.assert_array_equal(
+        cluster.merged_snapshot(keys, merge_key=17), got
+    )
+    cluster.shutdown()
+
+
+def test_merged_snapshot_is_uniform_mode_only(tmp_path):
+    cluster = ShardedReservoirService(
+        _cfg("weighted"), 2, str(tmp_path / "cl"), key=1
+    )
+    cluster.open_session("a")
+    cluster.ingest(
+        "a", np.arange(4, dtype=np.int32), weights=np.ones(4, np.float32)
+    )
+    with pytest.raises(ValueError, match="uniform-mode only"):
+        cluster.merged_snapshot(["a"])
+    cluster.shutdown()
+
+
+# ------------------------------------------------- cluster status surface
+
+
+def test_cluster_heartbeat_renders_per_shard_panel(tmp_path):
+    """``cluster.beat()`` aggregates per-shard health into ONE
+    heartbeat.json, and ``tools/reservoir_top.py`` renders it as a
+    per-shard panel — with a DOWN banner naming the dead shard."""
+    import reservoir_top
+
+    cfg = _cfg()
+    cl_dir = str(tmp_path / "cl")
+    cluster = ShardedReservoirService(cfg, 3, cl_dir, key=2)
+    for i in range(6):
+        k = f"s{i}"
+        cluster.open_session(k)
+        cluster.ingest(k, np.arange(8, dtype=np.int32))
+    cluster.sync()
+    hb = cluster.beat()
+    assert set(hb["shards"]) == {"0", "1", "2"}
+    assert hb["worst"] == "ok" and hb["sessions_open"] == 6
+    frame = reservoir_top.render(reservoir_top.collect(cl_dir))
+    assert "cluster: 3 shards" in frame
+    assert "shard" in frame and "alive" in frame
+    assert "SHARD DOWN" not in frame
+    # kill one shard: the next beat and frame say exactly which
+    cluster.kill_shard(1)
+    cluster.beat()
+    frame = reservoir_top.render(reservoir_top.collect(cl_dir))
+    assert "** SHARD DOWN: 1 (killed) **" in frame
+    assert "worst=page" in frame
+    cluster.promote_shard(1)
+    cluster.beat()
+    frame = reservoir_top.render(reservoir_top.collect(cl_dir))
+    assert "SHARD DOWN" not in frame
+    cluster.shutdown()
+
+
+# ------------------------------------------------------------- chaos soak
+
+
+class _Recording:
+    """Loadgen-compatible wrapper that records what each live lease was
+    actually fed (successful calls only) — the ground truth the
+    per-session oracle replays consume."""
+
+    def __init__(self, cluster, fed):
+        self._c = cluster
+        self.fed = fed
+
+    def open_session(self, key):
+        sess = self._c.open_session(key)
+        self.fed[key] = []
+        return sess
+
+    def ingest(self, key, elements, weights=None):
+        n = self._c.ingest(key, elements, weights)
+        self.fed[key].extend(np.asarray(elements).tolist())
+        return n
+
+    def snapshot(self, key, sync=True):
+        return self._c.snapshot(key, sync=sync)
+
+    def close_session(self, key):
+        out = self._c.close_session(key)
+        self.fed.pop(key, None)
+        return out
+
+
+def _assert_sessions_bit_exact(cluster, fed, cfg, where):
+    """Every live lease with a tracked feed is bit-identical to its
+    per-shard oracle; banded sessions additionally prove zero cross-shard
+    (and cross-tenant) contamination."""
+    checked = 0
+    for unit in cluster.units:
+        if not unit.alive:
+            continue
+        for sess in list(unit.table.sessions()):
+            elems = fed.get(sess.key)
+            if elems is None:
+                continue
+            got = unit.service.snapshot(sess.key)
+            if sess.key.startswith("c"):
+                base = (int(sess.key[1:]) + 1) * 10_000
+                assert np.all((got >= base) & (got < base + 5000)), (
+                    f"{where}: cross-shard contamination in {sess.key} "
+                    f"(shard {unit.shard_id}): {got}"
+                )
+            want = _oracle_replay(
+                cfg, unit.engine_seed, unit.table, sess,
+                np.asarray(elems, np.int32),
+            )
+            np.testing.assert_array_equal(
+                got, want, err_msg=f"{where}: {sess.key}"
+            )
+            checked += 1
+    return checked
+
+
+def _assert_non_victims_ok(cluster, victim, where):
+    for unit in cluster.units:
+        if unit.shard_id == victim or not unit.alive:
+            continue
+        verdicts = unit.slo_verdicts()
+        assert verdicts and all(v == "ok" for v in verdicts.values()), (
+            f"{where}: healthy shard {unit.shard_id} SLO flipped: {verdicts}"
+        )
+
+
+def _close_prefixed(rec, cluster, prefix):
+    for unit in cluster.units:
+        if not unit.alive:
+            continue
+        for sess in list(unit.table.sessions()):
+            if not sess.key.startswith(prefix):
+                continue
+            for _ in range(4):
+                try:
+                    rec.close_session(sess.key)
+                    break
+                except SessionIngestError:
+                    continue  # injected route fault: per-call, retry
+                except (UnknownSessionError, ShardUnavailable):
+                    break
+
+
+def _promote_with_retry(cluster, victim, reason):
+    for _ in range(12):
+        try:
+            return cluster.promote_shard(victim, reason=reason)
+        except TransientDeviceError:
+            continue  # injected shard.promote fault: standby unharmed
+    raise AssertionError("promotion never landed past injected faults")
+
+
+@pytest.mark.parametrize("gated", [False, True], ids=["ungated", "gated"])
+def test_cluster_chaos_soak_kill_fence_promote_recover(tmp_path, gated):
+    """The ISSUE-9 acceptance soak (11 cycles per variant, 22 across the
+    gated x ungated matrix): randomized kill / fence / promote / recover
+    on randomly chosen shards under live ``tools/loadgen.py`` traffic,
+    with faults injected at the new ``shard.route`` / ``shard.promote``
+    sites (plus ``replica.ship`` for good measure).  After every cycle:
+    every live session is bit-identical to its per-shard oracle, banded
+    sessions show zero cross-shard contamination through recycles, the
+    fenced zombie cannot mutate its shard's journal, and no healthy
+    shard's SLO verdict ever left ``ok`` while the victim was down."""
+    CYCLES = 11
+    N_SHARDS = 3
+    cfg = _cfg()
+    plane = FaultPlane(
+        [
+            FaultRule(
+                "shard.route", exc=TransientDeviceError, after=40, every=97,
+            ),
+            FaultRule(
+                "shard.promote", exc=TransientDeviceError, after=1, every=3,
+            ),
+            FaultRule(
+                "replica.ship", exc=TransientDeviceError, after=3, every=11,
+            ),
+        ],
+        seed=29,
+    )
+    obs.enable(obs.Registry())
+    cluster = ShardedReservoirService(
+        cfg,
+        N_SHARDS,
+        str(tmp_path / "cl"),
+        key=31,
+        coalesce_bytes=64,
+        ttl_s=3600.0,
+        gated=gated,
+        faults=plane,
+        # staleness is wall-clock-paced: chaos phases (promote bootstraps,
+        # oracle replays) age the snapshot cache by design here, so the
+        # objective gets a test-pacing threshold — the SCOPING is what
+        # this soak pins (a neighbor's outage must not flip MY verdict),
+        # not the production threshold value
+        slo_kwargs={"staleness_s": 60.0},
+    )
+    fed: dict = {}
+    rec = _Recording(cluster, fed)
+    rng = np.random.default_rng(37 + int(gated))
+    live_banded: list = []
+    next_banded = 0
+
+    def banded_traffic(rounds=8):
+        # every op tolerates a per-call injected shard.route fault
+        # (SessionIngestError): real callers retry; the recorder records
+        # successful calls only, so the oracle ledger stays exact
+        nonlocal next_banded
+        for _ in range(rounds):
+            op = rng.random()
+            if (op < 0.3 and len(live_banded) < 6) or not live_banded:
+                key = f"c{next_banded}"
+                next_banded += 1
+                try:
+                    rec.open_session(key)
+                except SessionIngestError:
+                    continue  # injected route fault: the open never ran
+                live_banded.append(key)
+            elif op < 0.85:
+                key = live_banded[int(rng.integers(len(live_banded)))]
+                unit = cluster.unit(cluster.shard_of(key))
+                if (
+                    key not in fed
+                    or not unit.alive
+                    or key not in unit.table
+                ):
+                    # evicted under row pressure (or its shard is mid-
+                    # outage): the lease is gone, drop the ledger entry
+                    live_banded.remove(key)
+                    fed.pop(key, None)
+                    continue
+                n = int(rng.integers(1, 14))
+                base = (int(key[1:]) + 1) * 10_000
+                try:
+                    rec.ingest(
+                        key,
+                        (base + rng.integers(0, 5000, n)).astype(np.int32),
+                    )
+                except SessionIngestError:
+                    pass  # not recorded, not applied: ledger consistent
+            else:
+                key = live_banded.pop(int(rng.integers(len(live_banded))))
+                if key in fed:
+                    try:
+                        rec.close_session(key)
+                    except SessionIngestError:
+                        live_banded.append(key)  # close never ran: retry later
+                    except (UnknownSessionError, ShardUnavailable):
+                        fed.pop(key, None)
+
+    def loadgen_burst(cycle, tag):
+        spec = loadgen.LoadSpec(
+            duration_s=0.08,
+            rate=300.0,
+            arrivals="bursty" if cycle % 2 else "poisson",
+            sessions=10,
+            zipf_s=0.6,
+            chunk=8,
+            churn=0.05,
+            snapshot_every=9,
+            seed=1000 * cycle + tag,
+        )
+        return loadgen.run_load(rec, spec)
+
+    # warm pass: jit every flush shape, then pin each shard's SLO
+    # baseline frame so the soak judges soak-time behavior only
+    banded_traffic()
+    loadgen_burst(0, 0)
+    cluster.sync()
+    _close_prefixed(rec, cluster, "s")
+    for unit in cluster.units:
+        assert unit.slo_verdicts()  # creates the per-shard plane
+
+    promotions = 0
+    for cycle in range(CYCLES):
+        banded_traffic()
+        res = loadgen_burst(cycle, 1)
+        assert res.completed > 0
+        cluster.sync()
+        _close_prefixed(rec, cluster, "s")
+        cluster.poll()
+        victim = int(rng.integers(N_SHARDS))
+        action = cycle % 3
+        where = f"cycle {cycle} ({'kill' if action == 0 else 'fence' if action == 1 else 'recover'}, shard {victim})"
+        if action == 0:
+            # KILL -> live mid-outage traffic -> PROMOTE the hot standby
+            zombie = cluster.kill_shard(victim)
+            mid = loadgen_burst(cycle, 2)
+            assert mid.completed > 0, f"{where}: survivors stopped serving"
+            _assert_non_victims_ok(cluster, victim, where)
+            _promote_with_retry(cluster, victim, reason=where)
+            promotions += 1
+            # the fenced zombie cannot claim or mutate anything durable:
+            # its probes leave the shard's journal byte-identical
+            journal_before = _journal_bytes(cluster.shard_dir(victim))
+            with pytest.raises(FencedError):
+                zombie.sync()
+            assert (
+                _journal_bytes(cluster.shard_dir(victim)) == journal_before
+            ), f"{where}: zombie mutated the journal"
+            assert zombie.bridge.metrics.fenced_writes >= 1
+        elif action == 1:
+            # FENCE the live primary: its next durable write trips, the
+            # cluster marks the shard down scoped, the standby promotes
+            cluster.fence_shard(victim)
+            cluster.sync()  # trips + marks the fenced shard, skips it
+            assert not cluster.unit(victim).alive
+            assert cluster.unit(victim).unavailable_reason == "fenced"
+            probe = _key_for_shard(cluster, victim, f"p{cycle}_")
+            for _ in range(4):
+                try:
+                    rec.open_session(probe)
+                    raise AssertionError(f"{where}: fenced shard served")
+                except SessionIngestError:
+                    continue  # injected route fault first: retry the probe
+                except ShardUnavailable as e:
+                    assert e.shard == victim
+                    break
+            _assert_non_victims_ok(cluster, victim, where)
+            _promote_with_retry(cluster, victim, reason=where)
+            promotions += 1
+        else:
+            # KILL -> stop-the-world recover() from the shard's own dir
+            # (no fence movement: the ISSUE-9 pre-flight passes)
+            cluster.kill_shard(victim)
+            mid = loadgen_burst(cycle, 2)
+            assert mid.completed > 0
+            _assert_non_victims_ok(cluster, victim, where)
+            cluster.recover_shard(victim)
+        cluster.sync()
+        checked = _assert_sessions_bit_exact(cluster, fed, cfg, where)
+        assert checked > 0, f"{where}: soak asserted nothing"
+        _close_prefixed(rec, cluster, "s")
+        if cycle % 3 == 0:
+            hb = cluster.beat()
+            assert set(hb["shards"]) == {str(i) for i in range(N_SHARDS)}
+    assert promotions >= CYCLES // 2
+    # the soak exercised the new sites
+    hits = plane.hits()
+    assert hits.get("shard.route", 0) > 100, hits
+    assert hits.get("shard.promote", 0) >= promotions, hits
+    cluster.shutdown()
+    obs.disable()

@@ -151,8 +151,9 @@ def test_disabled_plane_is_zero_overhead_noop(monkeypatch):
 def test_all_sites_exercised(tmp_path):
     # a rule-free global plane counts hits without raising: one bridge
     # stream with auto-checkpointing must cross every site of ISSUE 3,
-    # one serve-plane ingest the ISSUE-4 site, and one replication poll +
-    # heartbeat the ISSUE-5 sites
+    # one serve-plane ingest the ISSUE-4 site, one replication poll +
+    # heartbeat the ISSUE-5 sites, and one cluster route + shard
+    # promotion the ISSUE-9 sites
     with faults.active(FaultPlane()) as plane:
         bridge = DeviceStreamBridge(
             _cfg(),
@@ -172,6 +173,7 @@ def test_all_sites_exercised(tmp_path):
         from reservoir_tpu.serve import (
             HeartbeatWriter,
             ReservoirService,
+            ShardedReservoirService,
             StandbyReplica,
         )
 
@@ -185,6 +187,19 @@ def test_all_sites_exercised(tmp_path):
         standby = StandbyReplica(ha_dir)
         standby.poll()
         HeartbeatWriter(ha_dir, service=svc).beat()
+        # shard.route fires on the cluster's session->shard resolution,
+        # shard.promote on a shard unit's failover promotion (ISSUE 9)
+        cluster = ShardedReservoirService(
+            _cfg(), 2, str(tmp_path / "cl"), key=1
+        )
+        cluster.open_session("t")
+        cluster.ingest("t", np.arange(4, dtype=np.int32))
+        cluster.sync()
+        cluster.poll()
+        victim = cluster.shard_of("t")
+        cluster.kill_shard(victim)
+        cluster.promote_shard(victim)
+        cluster.shutdown()
         hits = plane.hits()
     for site in faults.SITES:
         assert hits.get(site, 0) >= 1, (site, hits)
@@ -667,6 +682,80 @@ def test_heartbeat_fault_starves_beacon_and_controller_promotes(tmp_path):
     assert standby.metrics.promotions == 1
     with pytest.raises(FencedError):
         svc.sync()  # and the fenced old primary is out
+
+
+# ------------------------------------------------- shard sites (ISSUE 9)
+
+
+def _cluster(tmp_path, plane=None, n_shards=2, key=6):
+    from reservoir_tpu.serve import ShardedReservoirService
+
+    return ShardedReservoirService(
+        _cfg(num_reservoirs=3), n_shards, str(tmp_path / "cl"), key=key,
+        coalesce_bytes=64, faults=plane,
+    )
+
+
+def test_shard_route_fault_is_typed_and_cluster_stays_live(tmp_path):
+    """The ISSUE-9 matrix entry for ``shard.route``: an injected failure
+    in the cluster's session->shard resolution surfaces as a typed
+    per-call :class:`SessionIngestError` (cause chained) — the routing
+    table is untouched, the failing key re-routes identically on the
+    next call, and every other session keeps serving."""
+    from reservoir_tpu.errors import SessionIngestError
+
+    plane = FaultPlane(
+        [FaultRule("shard.route", exc=TransientDeviceError, after=2,
+                   times=1, message="injected route fault")]
+    )
+    cluster = _cluster(tmp_path, plane)
+    cluster.open_session("a")  # hit 0: clean
+    cluster.open_session("b")  # hit 1: clean
+    with pytest.raises(SessionIngestError, match="shard routing") as ei:
+        cluster.ingest("a", np.arange(8, dtype=np.int32))  # hit 2: injected
+    assert isinstance(ei.value.__cause__, TransientDeviceError)
+    # not a wedge, and the route is unchanged: both keys keep serving on
+    # the same deterministic shards
+    shard_a = cluster.shard_of("a")
+    cluster.ingest("a", np.arange(8, dtype=np.int32))
+    cluster.ingest("b", np.arange(8, dtype=np.int32))
+    assert cluster.shard_of("a") == shard_a
+    assert cluster.snapshot("a").size > 0
+    assert cluster.snapshot("b").size > 0
+    assert plane.hits()["shard.route"] >= 3
+    cluster.shutdown()
+
+
+def test_shard_promote_fault_leaves_standby_unpromoted_and_retryable(
+    tmp_path,
+):
+    """``shard.promote``: the site fires BEFORE the standby flip, so an
+    injected failure leaves the standby un-promoted (no epoch bump, no
+    journal adoption) and the promotion is simply retried — the shard
+    comes back on the retry with bit-identical state."""
+    plane = FaultPlane(
+        [FaultRule("shard.promote", exc=TransientDeviceError, times=1)]
+    )
+    cluster = _cluster(tmp_path, plane, key=8)
+    cluster.open_session("a")
+    cluster.ingest("a", np.arange(24, dtype=np.int32))
+    cluster.sync()
+    cluster.poll()
+    want = cluster.snapshot("a")
+    victim = cluster.shard_of("a")
+    unit = cluster.unit(victim)
+    epoch_before = unit.epoch
+    cluster.kill_shard(victim)
+    with pytest.raises(TransientDeviceError):
+        cluster.promote_shard(victim)  # hit 0: injected, nothing flipped
+    assert not unit.alive
+    assert unit.epoch == epoch_before  # no epoch bump: fence untouched
+    assert unit.standby is not None and not unit.standby.is_promoted
+    cluster.promote_shard(victim)  # times=1 exhausted: the retry lands
+    assert unit.alive
+    assert unit.epoch == epoch_before + 1
+    np.testing.assert_array_equal(cluster.snapshot("a"), want)
+    cluster.shutdown()
 
 
 # -------------------------------------------------------- Pallas demotion

@@ -387,6 +387,87 @@ def test_controller_promotes_when_heartbeat_never_existed(tmp_path):
     assert report.should_promote and "no heartbeat" in report.reasons[0]
 
 
+# --------------------------------------- recovery pre-flight (ISSUE 9 sat.)
+
+
+def test_recover_preflight_rejects_fenced_lineage(tmp_path):
+    """The ISSUE-9 satellite: ``recover()`` cross-checks the epoch the
+    checkpoint lineage was admitted at against the persisted fence BEFORE
+    any replay and raises a typed ``CheckpointMismatch`` — not a
+    ``FencedError`` on the first post-recovery flush, and never a silent
+    adoption of the promoted primary's epoch (two journaling writers)."""
+    from reservoir_tpu.errors import CheckpointMismatch
+
+    cfg = _cfg(num_reservoirs=2)
+    ck = str(tmp_path / "ck")
+    svc = ReservoirService(
+        cfg, key=3, checkpoint_dir=ck, checkpoint_every=1000,
+        coalesce_bytes=32,
+    )
+    svc.open_session("a")
+    svc.ingest("a", np.arange(40, dtype=np.int32))
+    svc.sync()
+    standby = StandbyReplica(ck)
+    standby.poll()
+    # promote WITHOUT the handoff checkpoint: the persisted fence moves
+    # past the only on-disk checkpoint's recorded epoch
+    promoted = standby.promote(checkpoint=False)
+    with pytest.raises(CheckpointMismatch, match="fence is at epoch"):
+        ReservoirService.recover(ck)
+    # the promoted primary's own handoff checkpoint records the new
+    # epoch: recovery of the PROMOTED lineage is legitimate again
+    want = promoted.snapshot("a")
+    promoted.bridge._save_snapshot()
+    promoted.shutdown()
+    recovered = ReservoirService.recover(ck)
+    np.testing.assert_array_equal(recovered.snapshot("a"), want)
+
+
+# --------------------------------------- controller triggers (ISSUE 9 sat.)
+
+
+def test_controller_verdict_and_promotion_carry_trigger_tags(tmp_path):
+    """The ISSUE-9 satellite: the health verdict names its trigger as a
+    stable machine-readable tag (staleness vs watchdog vs demotions vs
+    slo_worst), paired 1:1 with the human ``reasons``, and a promotion
+    records the tags on the controller — so a chaos-soak failure can say
+    WHICH signal pulled the trigger without parsing strings."""
+    cfg = _cfg(num_reservoirs=2)
+    ck = str(tmp_path / "ck")
+    svc = ReservoirService(cfg, key=12, checkpoint_dir=ck)
+    svc.open_session("a")
+    svc.ingest("a", np.arange(20, dtype=np.int32))
+    svc.sync()
+    clock = _Clock()
+    hb = HeartbeatWriter(ck, service=svc, clock=clock)
+    # degraded-but-alive signals tag without promoting
+    svc.bridge.metrics.demotions = 2
+    hb.beat()
+    standby = StandbyReplica(ck)
+    standby.poll()
+    ctl = FailoverController(standby, heartbeat_timeout_s=5.0, clock=clock)
+    report = ctl.health()
+    assert not report.should_promote
+    assert report.triggers == ["demotions"]
+    assert len(report.triggers) == len(report.reasons)
+    # the watchdog signal promotes, and its tag leads the list
+    svc.bridge.metrics.watchdog_trips = 1
+    hb.beat()
+    report = ctl.health()
+    assert report.should_promote
+    assert report.triggers[0] == "watchdog"
+    assert "demotions" in report.triggers
+    # staleness tags too (the beats stop), and the promotion records the
+    # tags on the controller next to the human reason
+    clock.t += 10.0
+    report = ctl.health()
+    assert "staleness" in report.triggers
+    promoted = ctl.maybe_promote()
+    assert promoted is not None
+    assert ctl.last_promotion_triggers == report.triggers
+    assert "staleness" in ctl.last_promotion_triggers
+
+
 # ------------------------------------------------- durability knob satellite
 
 

@@ -397,6 +397,26 @@ def test_ha_rehearsal_post_step_registered():
     assert "ha" in tpu_watch.CONFIG_BUDGETS
 
 
+def test_shard_rehearsal_post_step_registered():
+    # the ISSUE-9 sharded-serving post-step: budget-capped, runs the
+    # cross-shard chaos soak + partial-failure pins on the native
+    # backend, ahead of recovery_rehearsal (which stays last); the
+    # shards bench config rides the capture queue too
+    steps = {name: (cmd, timeout, env) for name, cmd, timeout, env in
+             tpu_watch.POST_STEPS}
+    cmd, timeout, env = steps["shard_rehearsal"]
+    assert "tests/test_cluster.py" in cmd
+    assert "-k" in cmd and "soak" in cmd[cmd.index("-k") + 1]
+    assert 0 < timeout <= 900
+    assert env.get("RESERVOIR_TPU_TEST_PLATFORM") == "native"
+    order = [name for name, *_ in tpu_watch.POST_STEPS]
+    assert order.index("shard_rehearsal") < order.index(
+        "recovery_rehearsal"
+    )
+    assert "shards" in tpu_watch.DEFAULT_CONFIGS.split(",")
+    assert "shards" in tpu_watch.CONFIG_BUDGETS
+
+
 def test_parity_probe_post_step_registered(tmp_path, monkeypatch):
     # the ISSUE-7 satellite (ROADMAP item 3 tail): a budget-capped
     # on-device selftest runs FIRST in the post-step queue — parity
@@ -553,7 +573,7 @@ def test_post_step_rehearsal_sequential_gating(tmp_path, monkeypatch):
     assert [s[0] for s in remaining] == [
         "distinct_sweep", "pallas_device_tests", "algl_best_block",
         "serve_soak", "ha_rehearsal", "gated_sweep", "gated_rehearsal",
-        "recovery_rehearsal",
+        "shard_rehearsal", "recovery_rehearsal",
     ]
     assert committed == ["3 post-step(s) recorded"]
     rows = [

@@ -66,10 +66,12 @@ PUBLIC_MODULES = [
     "reservoir_tpu.parallel.multihost",
     "reservoir_tpu.parallel.sharded",
     "reservoir_tpu.serve",
+    "reservoir_tpu.serve.cluster",
     "reservoir_tpu.serve.ha",
     "reservoir_tpu.serve.replica",
     "reservoir_tpu.serve.service",
     "reservoir_tpu.serve.sessions",
+    "reservoir_tpu.serve.shard",
     "reservoir_tpu.stream",
     "reservoir_tpu.stream.bridge",
     "reservoir_tpu.stream.interop",

@@ -110,6 +110,19 @@ def _fence_line(status: dict) -> str:
     persisted = status.get("persisted_epoch")
     if hb is None:
         return "primary: NO HEARTBEAT"
+    if hb.get("cluster"):
+        # a cluster heartbeat (ISSUE 9): the summary line is the shard
+        # roll-up; the per-shard panel carries the detail
+        age = status["ts"] - float(hb.get("ts", 0.0))
+        line = (
+            f"cluster: {hb.get('n_shards', '?')} shards "
+            f"routing_epoch={hb.get('routing_epoch', '?')} "
+            f"sessions={hb.get('sessions_open', '?')} "
+            f"worst={hb.get('worst', '?')} beat {age:.1f}s ago"
+        )
+        if age > float(status.get("stale_after", 10.0)):
+            line += "  ** STALE **"
+        return line
     age = status["ts"] - float(hb.get("ts", 0.0))
     epoch = int(hb.get("epoch", 0))
     line = (
@@ -178,6 +191,39 @@ def _slo_lines(tel: Optional[dict]) -> list:
     return lines
 
 
+def _shard_lines(status: dict) -> list:
+    """The per-shard panel (ISSUE 9): one row per shard from a cluster
+    heartbeat — alive/epoch/seq/sessions/standby-lag/SLO — plus a banner
+    naming every down shard (a 1/N outage must be visible at a glance)."""
+    hb = status.get("heartbeat") or {}
+    shards = hb.get("shards")
+    if not shards:
+        return []
+    lines = [""]
+    down = sorted(
+        (s for s, row in shards.items() if not row.get("alive")), key=int
+    )
+    if down:
+        reasons = ", ".join(
+            f"{s} ({shards[s].get('reason') or 'down'})" for s in down
+        )
+        lines.append(f"** SHARD DOWN: {reasons} **")
+    lines.append(
+        f"{'shard':<7}{'alive':>6}{'epoch':>7}{'seq':>9}{'sessions':>10}"
+        f"{'lag':>6}{'slo':>6}"
+    )
+    for sid in sorted(shards, key=int):
+        row = shards[sid]
+        lines.append(
+            f"{sid:<7}{('yes' if row.get('alive') else 'NO'):>6}"
+            f"{row.get('epoch', '?'):>7}{row.get('seq', '—'):>9}"
+            f"{row.get('sessions_open', '—'):>10}"
+            f"{row.get('standby_lag_seq', '—'):>6}"
+            f"{row.get('slo_worst', '—'):>6}"
+        )
+    return lines
+
+
 def render(status: dict, prev: Optional[dict] = None) -> str:
     """One plain-text frame (pure function of the collected samples)."""
     lines = [
@@ -185,8 +231,9 @@ def render(status: dict, prev: Optional[dict] = None) -> str:
         f"@ {time.strftime('%H:%M:%S', time.localtime(status['ts']))}",
         _fence_line(status),
     ]
+    lines.extend(_shard_lines(status))
     hb = status.get("heartbeat")
-    if hb:
+    if hb and not hb.get("cluster"):
         lines.append(
             "health: "
             f"watchdog_trips={hb.get('watchdog_trips', 0)} "

@@ -96,6 +96,10 @@ CONFIG_BUDGETS: dict[str, tuple[float, dict[str, str]]] = {
     # universe, row carries corrected-wait quantiles + SLO verdicts + the
     # online sample-quality audit; host-path config, no parity selftest
     "traffic": (600.0, {"RESERVOIR_BENCH_SELFTEST": "0"}),
+    # the ISSUE-9 sharded serving plane: per-shard ingest rate +
+    # kill-one-shard failover time + merged-snapshot latency on the real
+    # backend; host-path config, no parity selftest
+    "shards": (420.0, {"RESERVOIR_BENCH_SELFTEST": "0"}),
 }
 
 # r5 priority order (VERDICT r4): parity-attached headline first, then
@@ -105,7 +109,7 @@ CONFIG_BUDGETS: dict[str, tuple[float, dict[str, str]]] = {
 # a CONFIG_BUDGETS row (an unbudgeted config can burn a whole window).
 DEFAULT_CONFIGS = (
     "algl,algl_chunk1024,algl_chunk0,distinct,weighted,stream,bridge,"
-    "bridge_serial,gated,serve,ha,traffic,algl_B4096"
+    "bridge_serial,gated,serve,ha,traffic,shards,algl_B4096"
 )
 
 def _now() -> str:
@@ -500,6 +504,27 @@ POST_STEPS: list[tuple[str, list[str], float, dict]] = [
             "--no-header",
             "-k",
             "reconcil or recover or soak",
+        ],
+        900.0,
+        {"RESERVOIR_TPU_TEST_PLATFORM": "native"},
+    ),
+    (
+        # shard rehearsal (ISSUE 9): the cross-shard chaos soak — kill/
+        # fence/promote/recover on randomly chosen shards under live
+        # loadgen traffic, per-session oracle bit-exactness, non-victim
+        # SLO verdicts pinned `ok` — run against the real backend,
+        # budget-capped like its siblings; ahead of recovery_rehearsal
+        # (which stays last)
+        "shard_rehearsal",
+        [
+            sys.executable,
+            "-m",
+            "pytest",
+            "tests/test_cluster.py",
+            "-q",
+            "--no-header",
+            "-k",
+            "soak or killed or fenced",
         ],
         900.0,
         {"RESERVOIR_TPU_TEST_PLATFORM": "native"},
