@@ -58,14 +58,16 @@ def main(n_devices: int = 8) -> None:
     R, k, N = 16, 8, 4096  # R reservoirs, k samples each, N elems per shard
     mesh = make_mesh(D, axis="stream")
 
-    # 1-2. disjoint shards, sampled independently (zero communication)
+    # 1-2. disjoint shards, sampled independently (zero communication);
+    # one jitted trace serves every same-shape shard fill
+    step = jax.jit(al.update)
     shard_states = []
     for d in range(D):
         st = al.init(jr.fold_in(jr.key(0), d), R, k)
         shard = jnp.tile(
             jnp.arange(d * N, (d + 1) * N, dtype=jnp.int32), (R, 1)
         )
-        shard_states.append(al.update(st, shard))
+        shard_states.append(step(st, shard))
 
     # 3. exact merge: one all_gather + a log2(D)-depth tree of
     # hypergeometric folds, identical on every device (replicated output)

@@ -42,8 +42,11 @@ module-global load and an ``is None`` test until :func:`enable` is called
     obs.disable()
 """
 
+from . import flight, trace
 from .events import EventLog, read_events
 from .export import json_snapshot, prometheus_text, write_json_snapshot
+from .flight import FlightRecorder, read_bundle
+from .trace import Span, Tracer, attribution
 from .registry import (
     Counter,
     Gauge,
@@ -62,6 +65,7 @@ from .slo import SLOPlane, SLOSpec, SLOVerdict, default_slos
 
 __all__ = [
     "Counter",
+    "FlightRecorder",
     "Gauge",
     "Histogram",
     "Registry",
@@ -70,16 +74,22 @@ __all__ = [
     "SLOSpec",
     "SLOVerdict",
     "SampleQualityAuditor",
+    "Span",
+    "Tracer",
     "active",
+    "attribution",
     "blocks",
     "default_slos",
     "disable",
     "emit",
     "enable",
+    "flight",
     "get_registry",
     "json_snapshot",
     "prometheus_text",
+    "read_bundle",
     "read_events",
     "register_block",
+    "trace",
     "write_json_snapshot",
 ]

@@ -29,6 +29,8 @@ import threading
 import time
 from typing import Dict, List, Optional
 
+from .registry import get as _registry_get
+
 __all__ = ["EventLog", "read_events"]
 
 
@@ -73,6 +75,15 @@ class EventLog:
         self._last_refill = now
         if self._tokens < 1.0:
             self._dropped[event] = self._dropped.get(event, 0) + 1
+            # the live half of the drop accounting (ISSUE 11 satellite):
+            # the in-stream telemetry.dropped summary only lands when the
+            # storm passes, but an SLO dashboard must see the log lying by
+            # omission WHILE it lies — so every drop also increments a
+            # registry counter (Counter holds its own lock and never takes
+            # this one, so the ordering is cycle-free)
+            reg = _registry_get()
+            if reg is not None:
+                reg.counter("telemetry.dropped").inc()
             return False
         self._tokens -= 1.0
         return True

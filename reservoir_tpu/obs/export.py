@@ -26,6 +26,7 @@ import tempfile
 import time
 from typing import Optional
 
+from . import trace as _trace
 from .registry import Counter, Gauge, Histogram, Registry, blocks, get
 
 __all__ = ["prometheus_text", "json_snapshot", "write_json_snapshot"]
@@ -113,6 +114,31 @@ def prometheus_text(
                         f'{name}{{slo="{_sanitize(key)}"}} '
                         f"{_fmt(value_of(verdicts[key]))}"
                     )
+    tracer = _trace.get()
+    if tracer is not None:
+        # causal-trace attribution (ISSUE 11): per-stage share of the
+        # end-to-end ingest wait, rendered only while a tracer is active
+        # (the golden-pinned base format is unchanged when tracing is off)
+        report = _trace.attribution(tracer.spans())
+        if report["traces"]:
+            name = f"{prefix}_trace_stage_share"
+            lines.append(f"# TYPE {name} gauge")
+            for stage in sorted(report["stages"]):
+                lines.append(
+                    f'{name}{{stage="{_sanitize(stage)}"}} '
+                    f'{_fmt(report["stages"][stage]["share"])}'
+                )
+            lines.append(
+                f'{name}{{stage="other"}} {_fmt(report["other"]["share"])}'
+            )
+            for metric, value in (
+                ("traces", report["traces"]),
+                ("e2e_p50_s", report["e2e_s"]["p50"]),
+                ("e2e_p99_s", report["e2e_s"]["p99"]),
+            ):
+                name = f"{prefix}_trace_{metric}"
+                lines.append(f"# TYPE {name} gauge")
+                lines.append(f"{name} {_fmt(value)}")
     if include_blocks:
         by_name: dict = {}
         for kind, idx, block in blocks():
@@ -155,6 +181,12 @@ def json_snapshot(
         # the verdict panel payload: rides heartbeat.json via the
         # HeartbeatWriter's embedded export, rendered by reservoir_top
         out["slo"] = plane.snapshot()
+    tracer = _trace.get()
+    if tracer is not None:
+        # the attribution panel payload (ISSUE 11): same conditional-key
+        # pattern as "slo" — present only while a tracer is active, so
+        # heartbeats and reservoir_top pick it up with no new wiring
+        out["trace"] = _trace.attribution(tracer.spans())
     return out
 
 

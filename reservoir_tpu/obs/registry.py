@@ -372,10 +372,25 @@ def active(registry: Optional[Registry] = None, **kwargs) -> Iterator[Registry]:
         _REGISTRY = prev
 
 
+# The flight recorder's event tap (obs/flight.py): when installed, every
+# emit() lands in its bounded ring — even events the rate-limited log
+# drops, and even with no registry active.  None (the default) costs one
+# global load + ``is None`` test, the same budget as the registry gate.
+_EVENT_TAP = None
+
+
+def _set_event_tap(tap) -> None:
+    global _EVENT_TAP
+    _EVENT_TAP = tap
+
+
 def emit(event: str, **fields) -> bool:
     """Write one structured event through the active registry's event log.
     No registry or no log: a no-op (global load + ``is None`` tests) —
     safe on any path, any rate."""
+    tap = _EVENT_TAP
+    if tap is not None:
+        tap(event, fields)
     reg = _REGISTRY
     if reg is None:
         return False

@@ -437,8 +437,25 @@ class SLOPlane:
                 value=value,
                 objective=spec.objective(),
             )
+        paged = [
+            name
+            for name, v in verdicts.items()
+            if v.verdict == "page"
+            and (
+                name not in self.last
+                or self.last[name].verdict != "page"
+            )
+        ]
         self._frames.append((now, frame))
         self.last = verdicts
+        if paged:
+            # an SLO page transition is a flight-recorder trigger (ISSUE
+            # 11): the page should arrive with its own postmortem bundle
+            from . import flight as _flight
+
+            fl = _flight.get()
+            if fl is not None:
+                fl.trigger("slo_page", slos=",".join(sorted(paged)))
         return verdicts
 
     def worst(self) -> str:

@@ -21,6 +21,7 @@ collective-free.
 
 from __future__ import annotations
 
+import functools
 from typing import Sequence, Tuple
 
 import numpy as np
@@ -34,6 +35,7 @@ from .sharded import shard_map as _shard_map
 from ..ops import algorithm_l as _algl
 from ..ops import distinct as _distinct
 from ..ops import weighted as _weighted
+from ..utils.tracing import trace_span
 
 __all__ = [
     "uniform_stream_merger",
@@ -97,30 +99,37 @@ def merge_samples_host(
         arr[0, : s.shape[0]] = s
         return jnp.asarray(arr), jnp.asarray([int(count)], jnp.uint32)
 
-    items = [_lift(s, c) for s, c in parts]
-    node = 0
-    while len(items) > 1:
-        nxt = []
-        for i in range(0, len(items) - 1, 2):
-            node += 1
-            s, c = _HOST_PAIRWISE(
-                items[i][0], items[i][1],
-                items[i + 1][0], items[i + 1][1],
-                jr.fold_in(key, node),
-            )
-            nxt.append((s, c))
-        if len(items) % 2:
-            nxt.append(items[-1])
-        items = nxt
-    samples, count = items[0]
+    with trace_span("reservoir_merge_host"):
+        items = [_lift(s, c) for s, c in parts]
+        node = 0
+        while len(items) > 1:
+            nxt = []
+            for i in range(0, len(items) - 1, 2):
+                node += 1
+                s, c = _HOST_PAIRWISE(
+                    items[i][0], items[i][1],
+                    items[i + 1][0], items[i + 1][1],
+                    jr.fold_in(key, node),
+                )
+                nxt.append((s, c))
+            if len(items) % 2:
+                nxt.append(items[-1])
+            items = nxt
+        samples, count = items[0]
     total = int(np.asarray(count)[0])
     return np.asarray(samples)[0, : min(total, k)], total
 
 
+@functools.lru_cache(maxsize=None)
 def uniform_stream_merger(mesh: Mesh, axis: str = "stream"):
     """Jitted ``fn(samples [D, R, k], count [D, R], key) -> (samples [R, k],
     count [R])`` merging per-device Algorithm-L results into one logical
     sample, replicated on every device.
+
+    Memoized per ``(mesh, axis)``: each call used to build a fresh
+    ``jax.jit`` wrapper, so repeated construction over the same mesh
+    re-traced and re-compiled the whole tree — the jit cache is keyed on
+    the wrapper identity, not the HLO.
 
     Inputs are the stacked per-shard results, sharded ``P(axis)`` on the
     leading device axis; the combine happens after an ``all_gather`` over
@@ -197,10 +206,12 @@ def _summary_merger(mesh: Mesh, axis: str, pairwise, n_leaves: int):
     )
 
 
+@functools.lru_cache(maxsize=None)
 def distinct_stream_merger(mesh: Mesh, axis: str = "stream"):
     """Jitted merger for stacked per-device ``DistinctState`` leaves
     ``(values, hash_hi, hash_lo, size, count)`` (salts shared across shards,
-    passed separately): returns the replicated merged leaves."""
+    passed separately): returns the replicated merged leaves.  Memoized
+    per ``(mesh, axis)`` like :func:`uniform_stream_merger`."""
 
     def pairwise(a, b):
         va, hia, loa, sza, ca, salts = a
@@ -213,9 +224,11 @@ def distinct_stream_merger(mesh: Mesh, axis: str = "stream"):
     return _summary_merger(mesh, axis, pairwise, n_leaves=6)
 
 
+@functools.lru_cache(maxsize=None)
 def weighted_stream_merger(mesh: Mesh, axis: str = "stream"):
     """Jitted merger for stacked per-device weighted results
-    ``(samples, lkeys, count)``: top-k-of-union, replicated."""
+    ``(samples, lkeys, count)``: top-k-of-union, replicated.  Memoized
+    per ``(mesh, axis)`` like :func:`uniform_stream_merger`."""
 
     def pairwise(a, b):
         return _weighted.merge_parts(*a, *b)

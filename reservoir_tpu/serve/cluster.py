@@ -46,6 +46,7 @@ Single-writer like everything below: one thread drives the cluster.
 
 from __future__ import annotations
 
+import contextlib
 import json
 import os
 import time
@@ -61,7 +62,9 @@ from ..errors import (
     ShardUnavailable,
 )
 from ..obs import registry as _obs
+from ..obs import trace as _ctrace
 from ..utils import faults as _faults
+from ..utils.tracing import trace_span
 from .service import ReservoirService
 from .shard import ShardUnit
 
@@ -210,6 +213,18 @@ class ShardedReservoirService:
         site (injected failures surface as a typed per-call
         :class:`SessionIngestError` — the cluster stays live) and turns a
         down shard into :class:`ShardUnavailable` scoped to it."""
+        tr = _ctrace.get()
+        cm = (
+            tr.span("cluster.route", key=key, session=key)
+            if tr is not None
+            else contextlib.nullcontext()
+        )
+        with cm, trace_span("reservoir_cluster_route"):
+            return self._route_impl(key, tr)
+
+    def _route_impl(
+        self, key: str, tr: Optional[Any]
+    ) -> Tuple[ShardUnit, int]:
         try:
             _faults.fire("shard.route", self._faults)
         except Exception as e:
@@ -219,6 +234,16 @@ class ShardedReservoirService:
         shard = self.shard_of(key)
         unit = self._units[shard]
         if not unit.alive:
+            if tr is not None:
+                # a routed-to-dead-shard reject is exactly the trace a
+                # postmortem wants: force it past the sampler
+                tr.point(
+                    "cluster.reject",
+                    session=key,
+                    shard=shard,
+                    error="ShardUnavailable",
+                    reason=unit.unavailable_reason or "unavailable",
+                )
             raise ShardUnavailable(
                 f"session {key!r} routes to shard {shard}, which is "
                 f"{unit.unavailable_reason or 'unavailable'}; retry after "
@@ -235,6 +260,15 @@ class ShardedReservoirService:
         Mark the shard down and re-raise scoped — every other shard is
         untouched."""
         unit.mark_fenced()
+        tr = _ctrace.get()
+        if tr is not None:
+            tr.point(
+                "cluster.reject",
+                shard=shard,
+                error="FencedError",
+                reason="fenced",
+                epoch=exc.observed_epoch,
+            )
         raise ShardUnavailable(
             f"shard {shard} primary is fenced (epoch "
             f"{exc.observed_epoch} > {exc.own_epoch}); promote its standby "
@@ -261,6 +295,15 @@ class ShardedReservoirService:
         return sess
 
     def ingest(self, key: str, elements: Any, weights: Optional[Any] = None) -> int:
+        tr = _ctrace.get()
+        if tr is None:
+            return self._ingest_impl(key, elements, weights)
+        with tr.span("cluster.ingest", key=key, session=key):
+            return self._ingest_impl(key, elements, weights)
+
+    def _ingest_impl(
+        self, key: str, elements: Any, weights: Optional[Any]
+    ) -> int:
         unit, shard = self._route(key)
         try:
             return unit.service.ingest(key, elements, weights)

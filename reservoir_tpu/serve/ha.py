@@ -33,6 +33,7 @@ missing heartbeat (stale after the timeout).  Both are pinned by
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import json
 import os
@@ -41,7 +42,9 @@ import time
 from typing import Any, List, Optional, Tuple
 
 from ..errors import FencedError
+from ..obs import flight as _flight
 from ..obs import registry as _obs
+from ..obs import trace as _ctrace
 from ..obs.export import json_snapshot
 from ..utils import faults as _faults
 from ..utils.checkpoint import read_epoch
@@ -123,6 +126,19 @@ class HeartbeatWriter:
                 epoch=current,
                 own_epoch=self._epoch,
             )
+            tr = _ctrace.get()
+            if tr is not None:
+                tr.point(
+                    "ha.fenced", epoch=current, own_epoch=self._epoch
+                )
+            fl = _flight.get()
+            if fl is not None:
+                fl.trigger(
+                    "fenced",
+                    epoch=current,
+                    own_epoch=self._epoch,
+                    checkpoint_dir=self._dir,
+                )
             raise FencedError(
                 f"heartbeat fenced: {self._dir!r} is at primary epoch "
                 f"{current}, this writer was admitted at {self._epoch}",
@@ -230,6 +246,7 @@ class FailoverController:
         self._faults = faults
         self._metrics = standby.metrics
         self._first_check_t: Optional[float] = None
+        self._was_healthy = True
         self.last_promotion_reason: Optional[str] = None
         self.last_promotion_triggers: List[str] = []
 
@@ -307,7 +324,7 @@ class FailoverController:
                 # failover would not fix a biased sampler anyway
                 degraded.append(("slo_worst", f"degraded: SLO {worst}"))
         signals = promote + degraded
-        return HealthReport(
+        report = HealthReport(
             healthy=not signals,
             should_promote=bool(promote),
             reasons=[r for _, r in signals],
@@ -315,6 +332,19 @@ class FailoverController:
             heartbeat=hb,
             triggers=[t for t, _ in signals],
         )
+        was_healthy, self._was_healthy = self._was_healthy, report.healthy
+        if was_healthy and not report.healthy and not report.should_promote:
+            # healthy -> degraded transition (promote-worthy verdicts dump
+            # from promote() itself): capture the flight ring while the
+            # degradation is fresh, rate-limited per reason
+            fl = _flight.get()
+            if fl is not None:
+                fl.trigger(
+                    "degraded",
+                    triggers=",".join(report.triggers),
+                    checkpoint_dir=self._dir,
+                )
+        return report
 
     def maybe_promote(self) -> Optional[Any]:
         """One control-loop step: promote iff the health verdict says so.
@@ -337,7 +367,16 @@ class FailoverController:
         record (``ha.promote_decision``, ISSUE-9 satellite) names the
         trigger tags alongside the human reason, so a chaos-soak failure
         can say *which* signal pulled the trigger."""
-        service = self._standby.promote()
+        tr = _ctrace.get()
+        cm = (
+            tr.span("ha.promote", force=True, reason=reason)
+            if tr is not None
+            else contextlib.nullcontext()
+        )
+        with cm as span:
+            service = self._standby.promote()
+            if span is not None:
+                span.fields["epoch"] = getattr(service, "epoch", None)
         self.last_promotion_reason = reason
         self.last_promotion_triggers = list(triggers or [])
         _obs.emit(
@@ -346,4 +385,12 @@ class FailoverController:
             reason=reason,
             triggers=",".join(self.last_promotion_triggers) or "manual",
         )
+        fl = _flight.get()
+        if fl is not None:
+            fl.trigger(
+                "promotion",
+                promote_reason=reason,
+                triggers=",".join(self.last_promotion_triggers) or "manual",
+                checkpoint_dir=self._dir,
+            )
         return service

@@ -47,6 +47,7 @@ Single-writer like everything below it: one thread owns a replica's
 
 from __future__ import annotations
 
+import contextlib
 import json
 import os
 import time
@@ -56,6 +57,7 @@ from typing import Any, Deque, List, Optional, Tuple
 import numpy as np
 
 from ..obs import registry as _obs
+from ..obs import trace as _ctrace
 from ..stream.bridge import DeviceStreamBridge, _FlushJournal
 from ..utils import faults as _faults
 from ..utils.checkpoint import (
@@ -573,8 +575,17 @@ class StandbyReplica:
                 # construction (counter-keyed draws); gated frames apply
                 # through the same gated engine path (ISSUE 8)
                 reg = _obs.get()
+                tr = _ctrace.get()
                 t0 = time.perf_counter() if reg is not None else 0.0
-                with trace_span("reservoir_replica_apply"):
+                # causal root keyed by the flush seq: the same stable hash
+                # the bridge used, so a sampled flush is sampled here too
+                # and the two sides of a journal frame join on flush_seq
+                acm = (
+                    tr.span("replica.apply", key=seq, flush_seq=seq)
+                    if tr is not None
+                    else contextlib.nullcontext()
+                )
+                with acm, trace_span("reservoir_replica_apply"):
                     if advance is not None:
                         self._engine.sample_gated(tile, valid, advance)
                     else:

@@ -45,6 +45,7 @@ in front for multi-producer traffic.
 
 from __future__ import annotations
 
+import contextlib
 import json
 import os
 import time
@@ -54,6 +55,7 @@ import numpy as np
 
 from ..config import SamplerConfig
 from ..obs import registry as _obs
+from ..obs import trace as _trace
 from ..errors import (
     CheckpointMismatch,
     RetryPolicy,
@@ -223,6 +225,7 @@ class ReservoirService:
         # triples appended per ingest, shipped as ONE interleaved push
         self._pend: List[Tuple[np.ndarray, np.ndarray, Optional[np.ndarray]]] = []
         self._pend_bytes = 0
+        self._pend_t0 = time.perf_counter()
         # snapshot cache: (samples, sizes) host arrays keyed by
         # (flushed_seq, reset_epoch) — reset_epoch invalidates on row
         # recycling, else a cached snapshot could leak the previous
@@ -419,6 +422,31 @@ class ReservoirService:
         The elements join the cross-session coalesce buffer and ship
         through the bridge's interleaved demux once ``coalesce_bytes``
         accumulate (or at the next sync/snapshot barrier)."""
+        # causal trace root (ISSUE 11): head-sampled on the session key —
+        # the same stable hash at every site, so a kept session's route/
+        # admission/ship/gate spans all land in one trace.  One global
+        # load + None test when tracing is disabled (trip-wire pinned).
+        # Opened FIRST so the root's duration covers the whole call —
+        # sweep and telemetry setup included — and the attribution
+        # reconciles with a caller's wall clock up to span bookkeeping.
+        tr = _trace.get()
+        if tr is not None:
+            with tr.span(
+                "serve.ingest",
+                key=key,
+                session=key,
+                shard=self._obs_scope,
+            ):
+                return self._ingest_counted(key, elements, weights, tr)
+        return self._ingest_counted(key, elements, weights, None)
+
+    def _ingest_counted(
+        self,
+        key: str,
+        elements: Any,
+        weights: Optional[Any],
+        tr: Optional[Any],
+    ) -> int:
         self._maybe_sweep()
         # telemetry (ISSUE 6): admission latency — accept-path wall time,
         # including any coalesce-buffer ship this call triggers.  One
@@ -431,7 +459,17 @@ class ReservoirService:
         t0 = time.perf_counter() if reg is not None else 0.0
         try:
             n = self._ingest_impl(key, elements, weights)
-        except (SessionIngestError, ServiceSaturated):
+        except (SessionIngestError, ServiceSaturated) as e:
+            if tr is not None:
+                # rejections force-sample: the traces worth keeping are
+                # never the ones the head sampler happened to keep
+                tr.point(
+                    "serve.reject",
+                    session=key,
+                    shard=self._obs_scope,
+                    error=type(e).__name__,
+                    flush_seq=self._bridge.flushed_seq,
+                )
             if reg is not None:
                 reg.counter(self._scoped("serve.ingest_total")).inc()
                 reg.counter(self._scoped("serve.ingest_errors")).inc()
@@ -446,77 +484,97 @@ class ReservoirService:
     def _ingest_impl(
         self, key: str, elements: Any, weights: Optional[Any]
     ) -> int:
-        sess = self._table.route(key)
-        try:
-            _faults.fire("serve.ingest", self._faults)
-        except Exception as e:
-            raise SessionIngestError(key, f"{type(e).__name__}: {e}") from e
-        try:
-            arr = np.atleast_1d(np.ascontiguousarray(elements, self._dtype))
-        except (TypeError, ValueError) as e:
-            raise SessionIngestError(
-                key, f"elements not convertible to {self._dtype}: {e}"
-            ) from None
-        if arr.ndim != 1:
-            raise SessionIngestError(
-                key, f"elements must be 1-D, got shape {arr.shape}"
-            )
-        warr: Optional[np.ndarray] = None
-        if self._config.weighted:
-            if weights is None:
-                raise SessionIngestError(
-                    key, "weighted service requires weights"
-                )
-            warr = np.atleast_1d(np.ascontiguousarray(weights, np.float32))
-            if warr.shape != arr.shape:
-                raise SessionIngestError(
-                    key,
-                    f"weights must match elements shape {arr.shape}, got "
-                    f"{warr.shape}",
-                )
-            if not np.all(warr >= 0):
-                bad = int(np.argmax(warr < 0))
-                raise SessionIngestError(
-                    key,
-                    f"weights must be nonnegative (weights[{bad}] = "
-                    f"{warr[bad]})",
-                )
-        elif weights is not None:
-            raise SessionIngestError(
-                key, "weights are only meaningful with weighted=True"
-            )
-        nbytes = arr.nbytes + (warr.nbytes if warr is not None else 0)
-        if nbytes > self._max_inflight_bytes:
-            raise SessionIngestError(
-                key,
-                f"single request of {nbytes} bytes exceeds "
-                f"max_inflight_bytes={self._max_inflight_bytes} (split it)",
-            )
-        # Admission: past the coalesce threshold a flush is due, but a
-        # saturated pipeline means flushing would BLOCK — buffer on while
-        # the hard byte budget allows, then reject with a retry hint.
-        # (Never block the ingest path on a slow device: bounded memory and
-        # an explicit 429 is the contract.)
-        saturated = (
-            self._pend_bytes + nbytes >= self._coalesce_bytes
-            and self._bridge.flush_would_block()
+        tr = _trace.get()
+        adm_cm = (
+            tr.span("serve.admission", session=key)
+            if tr is not None
+            else contextlib.nullcontext()
         )
-        if saturated and self._pend_bytes + nbytes > self._max_inflight_bytes:
-            self._metrics.rejections += 1
-            _obs.emit(
-                "serve.rejected",
-                site="serve.ingest",
-                session=key,
-                pending_bytes=self._pend_bytes + nbytes,
-                flush_seq=self._bridge.flushed_seq,
+        with adm_cm:
+            sess = self._table.route(key)
+            try:
+                _faults.fire("serve.ingest", self._faults)
+            except Exception as e:
+                raise SessionIngestError(
+                    key, f"{type(e).__name__}: {e}"
+                ) from e
+            try:
+                arr = np.atleast_1d(
+                    np.ascontiguousarray(elements, self._dtype)
+                )
+            except (TypeError, ValueError) as e:
+                raise SessionIngestError(
+                    key, f"elements not convertible to {self._dtype}: {e}"
+                ) from None
+            if arr.ndim != 1:
+                raise SessionIngestError(
+                    key, f"elements must be 1-D, got shape {arr.shape}"
+                )
+            warr: Optional[np.ndarray] = None
+            if self._config.weighted:
+                if weights is None:
+                    raise SessionIngestError(
+                        key, "weighted service requires weights"
+                    )
+                warr = np.atleast_1d(
+                    np.ascontiguousarray(weights, np.float32)
+                )
+                if warr.shape != arr.shape:
+                    raise SessionIngestError(
+                        key,
+                        f"weights must match elements shape {arr.shape}, "
+                        f"got {warr.shape}",
+                    )
+                if not np.all(warr >= 0):
+                    bad = int(np.argmax(warr < 0))
+                    raise SessionIngestError(
+                        key,
+                        f"weights must be nonnegative (weights[{bad}] = "
+                        f"{warr[bad]})",
+                    )
+            elif weights is not None:
+                raise SessionIngestError(
+                    key, "weights are only meaningful with weighted=True"
+                )
+            nbytes = arr.nbytes + (warr.nbytes if warr is not None else 0)
+            if nbytes > self._max_inflight_bytes:
+                raise SessionIngestError(
+                    key,
+                    f"single request of {nbytes} bytes exceeds "
+                    f"max_inflight_bytes={self._max_inflight_bytes} "
+                    "(split it)",
+                )
+            # Admission: past the coalesce threshold a flush is due, but a
+            # saturated pipeline means flushing would BLOCK — buffer on
+            # while the hard byte budget allows, then reject with a retry
+            # hint.  (Never block the ingest path on a slow device:
+            # bounded memory and an explicit 429 is the contract.)
+            saturated = (
+                self._pend_bytes + nbytes >= self._coalesce_bytes
+                and self._bridge.flush_would_block()
             )
-            raise ServiceSaturated(
-                f"in-flight bytes {self._pend_bytes + nbytes} over budget "
-                f"{self._max_inflight_bytes} with the flush pipeline "
-                "saturated",
-                retry_after_s=self._retry_hint(),
-            )
+            if saturated and (
+                self._pend_bytes + nbytes > self._max_inflight_bytes
+            ):
+                self._metrics.rejections += 1
+                _obs.emit(
+                    "serve.rejected",
+                    site="serve.ingest",
+                    session=key,
+                    pending_bytes=self._pend_bytes + nbytes,
+                    flush_seq=self._bridge.flushed_seq,
+                )
+                raise ServiceSaturated(
+                    f"in-flight bytes {self._pend_bytes + nbytes} over "
+                    f"budget {self._max_inflight_bytes} with the flush "
+                    "pipeline saturated",
+                    retry_after_s=self._retry_hint(),
+                )
         n = int(arr.shape[0])
+        if not self._pend:
+            # coalesce-wait anchor: the first pending append starts the
+            # clock the traced ship stage reports as serve.coalesce_wait
+            self._pend_t0 = time.perf_counter()
         self._pend.append(
             (np.full(n, sess.row, np.int32), arr, warr)
         )
@@ -551,20 +609,39 @@ class ReservoirService:
             reg.histogram(
                 self._scoped("serve.coalesce_fill"), lo=1e-3, hi=10.0
             ).observe(self._pend_bytes / self._coalesce_bytes)
+        tr = _trace.get()
+        ship_cm = contextlib.nullcontext()
+        if tr is not None:
+            # coalesce wait: age of the buffer when it ships.  Detached —
+            # it spans many ingest calls' wall time, so folding it into
+            # one call's trace would break the attribution reconciliation.
+            marker = tr.point(
+                "serve.coalesce_wait",
+                force=False,
+                detached=True,
+                pending_bytes=self._pend_bytes,
+                flush_seq=self._bridge.flushed_seq,
+            )
+            marker.duration_s = time.perf_counter() - self._pend_t0
+            ship_cm = tr.span(
+                "serve.ship", pending_bytes=self._pend_bytes
+            )
         pend, self._pend, self._pend_bytes = self._pend, [], 0
-        streams = np.concatenate([p[0] for p in pend])
-        elems = np.concatenate([p[1] for p in pend])
-        warr = (
-            np.concatenate([p[2] for p in pend])
-            if self._config.weighted
-            else None
-        )
-        self._bridge.push_interleaved(streams, elems, warr)
-        # kick rows the demux filled to the device now instead of waiting
-        # for the next push to overflow them — but never at the cost of
-        # blocking the ingest path (the pipeline overlaps the dispatch)
-        if not self._bridge.flush_would_block():
-            self._bridge.flush()
+        with ship_cm:
+            streams = np.concatenate([p[0] for p in pend])
+            elems = np.concatenate([p[1] for p in pend])
+            warr = (
+                np.concatenate([p[2] for p in pend])
+                if self._config.weighted
+                else None
+            )
+            self._bridge.push_interleaved(streams, elems, warr)
+            # kick rows the demux filled to the device now instead of
+            # waiting for the next push to overflow them — but never at the
+            # cost of blocking the ingest path (the pipeline overlaps the
+            # dispatch)
+            if not self._bridge.flush_would_block():
+                self._bridge.flush()
 
     def sync(self) -> int:
         """Barrier: coalesce buffer -> staging -> device, then wait out the

@@ -39,13 +39,16 @@ export slot), so one saturated shard pages alone.
 
 from __future__ import annotations
 
+import contextlib
 import os
 import time
 from typing import Any, Dict, Optional
 
 from ..config import SamplerConfig
 from ..errors import RetryPolicy
+from ..obs import flight as _flight
 from ..obs import registry as _obs
+from ..obs import trace as _ctrace
 from ..utils import faults as _faults
 from ..utils.checkpoint import advance_epoch, read_epoch
 from .ha import FailoverController, HealthReport, HeartbeatWriter
@@ -231,6 +234,13 @@ class ShardUnit:
         _obs.emit(
             "shard.killed", site="shard.promote", shard=self.shard_id
         )
+        tr = _ctrace.get()
+        if tr is not None:
+            tr.point(
+                "shard.killed",
+                shard=self.shard_id,
+                flush_seq=zombie.flushed_seq,
+            )
         return zombie
 
     def fence(self) -> int:
@@ -265,7 +275,24 @@ class ShardUnit:
             # promoting over a live primary: it becomes the fenced zombie
             self.last_zombie = self._service
         assert self._controller is not None
-        promoted = self._controller.promote(reason=reason, triggers=triggers)
+        tr = _ctrace.get()
+        if tr is None:
+            promoted = self._controller.promote(
+                reason=reason, triggers=triggers
+            )
+        else:
+            with tr.span(
+                "shard.promote",
+                force=True,
+                shard=self.shard_id,
+                reason=reason,
+            ) as span:
+                promoted = self._controller.promote(
+                    reason=reason, triggers=triggers
+                )
+                if span is not None:
+                    span.fields["flush_seq"] = promoted.flushed_seq
+                    span.fields["epoch"] = self.epoch
         promoted._obs_scope = self._obs_scope
         self._service = promoted
         self._unavailable_reason = None
@@ -289,12 +316,30 @@ class ShardUnit:
             if k in self._service_kwargs
         }
         fwd.update(kwargs)
-        service = ReservoirService.recover(
-            self.checkpoint_dir,
-            obs_scope=self._obs_scope,
-            faults=self._faults,
-            **fwd,
+        tr = _ctrace.get()
+        cm = (
+            tr.span("shard.recover", force=True, shard=self.shard_id)
+            if tr is not None
+            else contextlib.nullcontext()
         )
+        with cm as span:
+            service = ReservoirService.recover(
+                self.checkpoint_dir,
+                obs_scope=self._obs_scope,
+                faults=self._faults,
+                **fwd,
+            )
+            if span is not None:
+                span.fields["flush_seq"] = service.flushed_seq
+                span.fields["epoch"] = self.epoch
+        fl = _flight.get()
+        if fl is not None:
+            fl.note(
+                "shard.recovered",
+                shard=self.shard_id,
+                flush_seq=service.flushed_seq,
+                epoch=self.epoch,
+            )
         self._service = service
         self._unavailable_reason = None
         self._arm()

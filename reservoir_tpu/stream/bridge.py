@@ -43,6 +43,7 @@ writer.  Wrap pushes in your own queue for multi-producer feeds.
 
 from __future__ import annotations
 
+import contextlib
 import os
 import queue
 import struct
@@ -64,7 +65,9 @@ from ..errors import (
     SamplerClosedError,
 )
 from ..native import NativeStaging
+from ..obs import flight as _flight
 from ..obs import registry as _obs
+from ..obs import trace as _ctrace
 from ..utils import faults as _faults
 from .gate import SkipGate, gate_ineligible_reason
 from ..utils.checkpoint import read_epoch
@@ -211,6 +214,12 @@ class _FlushPipeline:
                 self._metrics.watchdog_trips += 1
             self._cv.notify_all()
         self._fatal(exc)
+        tr = _ctrace.get()
+        if tr is not None:
+            tr.point("bridge.watchdog_trip", budget_s=self._watchdog_s)
+        fl = _flight.get()
+        if fl is not None:
+            fl.trigger("watchdog", budget_s=self._watchdog_s)
 
     def _fatal(self, exc: BaseException) -> None:
         """Terminal failure: fail the owner's future with the cause (the
@@ -951,7 +960,18 @@ class DeviceStreamBridge:
         """
         _faults.fire("bridge.dispatch", self._faults)
         t0 = time.perf_counter()
-        with trace_span("reservoir_bridge_flush"):
+        tr = _ctrace.get()
+        cm = (
+            tr.span(
+                "bridge.dispatch",
+                key=self._flush_seq,
+                flush_seq=self._flush_seq,
+                gated=advance is not None,
+            )
+            if tr is not None
+            else contextlib.nullcontext()
+        )
+        with cm, trace_span("reservoir_bridge_flush"):
             if advance is not None:
                 self._engine.sample_gated(tile, valid, advance)
             elif wtile is not None:
@@ -1022,7 +1042,18 @@ class DeviceStreamBridge:
             if self._pipeline is not None:
                 # wait until the OTHER tile's previous flight is done,
                 # then swap the demux onto it
-                self._pipeline.reserve()
+                tr = _ctrace.get()
+                qcm = (
+                    tr.span(
+                        "bridge.queue",
+                        key=self._flush_seq,
+                        flush_seq=self._flush_seq,
+                    )
+                    if tr is not None
+                    else contextlib.nullcontext()
+                )
+                with qcm:
+                    self._pipeline.reserve()
                 self._pipeline.submit(tile, valid, wtile)
                 self._buf = 1 - i
                 self._staging.attach(
@@ -1040,7 +1071,18 @@ class DeviceStreamBridge:
         if self._pipeline is not None:
             # block until the tile we are about to drain into is truly
             # free (the worker may still be reading it)
-            self._pipeline.reserve()
+            tr = _ctrace.get()
+            qcm = (
+                tr.span(
+                    "bridge.queue",
+                    key=self._flush_seq,
+                    flush_seq=self._flush_seq,
+                )
+                if tr is not None
+                else contextlib.nullcontext()
+            )
+            with qcm:
+                self._pipeline.reserve()
         i = self._buf
         tile, valid = self._tiles[i], self._valids[i]
         wtile = self._wtiles[i] if self._wtiles is not None else None
@@ -1102,8 +1144,15 @@ class DeviceStreamBridge:
             take = min(n - off, self._gate_push_chunk)
             chunk = arr[off : off + take]
             reg = _obs.get()
+            tr = _ctrace.get()
+            gcm = (
+                tr.span("gate.eval", stream=stream)
+                if tr is not None
+                else contextlib.nullcontext()
+            )
             t0 = time.perf_counter()
-            ev = gate.evaluate_row(stream, take)
+            with gcm, trace_span("reservoir_gate_eval"):
+                ev = gate.evaluate_row(stream, take)
             dt = time.perf_counter() - t0
             m.gate_eval_s += dt
             if reg is not None:
@@ -1156,8 +1205,15 @@ class DeviceStreamBridge:
             gate.resync(self._engine)
         m = self._metrics
         reg = _obs.get()
+        tr = _ctrace.get()
+        gcm = (
+            tr.span("gate.eval")
+            if tr is not None
+            else contextlib.nullcontext()
+        )
         t0 = time.perf_counter()
-        ev = gate.evaluate(valid)
+        with gcm, trace_span("reservoir_gate_eval"):
+            ev = gate.evaluate(valid)
         dt = time.perf_counter() - t0
         m.gate_eval_s += dt
         if reg is not None:
@@ -1196,10 +1252,20 @@ class DeviceStreamBridge:
         self._check_fence()
         gtile, nvalid, advance, total_adv = gate.take()
         self._flush_seq += 1
+        tr = _ctrace.get()
         if self._journal is not None:
             reg = _obs.get()
             t0 = time.perf_counter() if reg is not None else 0.0
-            with trace_span("reservoir_journal_append"):
+            jcm = (
+                tr.span(
+                    "bridge.journal",
+                    key=self._flush_seq,
+                    flush_seq=self._flush_seq,
+                )
+                if tr is not None
+                else contextlib.nullcontext()
+            )
+            with jcm, trace_span("reservoir_journal_append"):
                 self._journal.append_gated(
                     self._flush_seq, gtile, nvalid, advance
                 )
@@ -1208,7 +1274,17 @@ class DeviceStreamBridge:
                     time.perf_counter() - t0
                 )
         if self._pipeline is not None:
-            self._pipeline.reserve()
+            qcm = (
+                tr.span(
+                    "bridge.queue",
+                    key=self._flush_seq,
+                    flush_seq=self._flush_seq,
+                )
+                if tr is not None
+                else contextlib.nullcontext()
+            )
+            with qcm:
+                self._pipeline.reserve()
             self._pipeline.submit(gtile, nvalid, None, advance)
         else:
             self._dispatch_flush(gtile, nvalid, None, advance)
@@ -1237,8 +1313,14 @@ class DeviceStreamBridge:
         shows up in Perfetto next to the flush span) and, when telemetry
         is enabled, timed into the ``bridge.journal_append_s`` histogram."""
         reg = _obs.get()
+        tr = _ctrace.get()
         t0 = time.perf_counter() if reg is not None else 0.0
-        with trace_span("reservoir_journal_append"):
+        jcm = (
+            tr.span("bridge.journal", key=seq, flush_seq=seq)
+            if tr is not None
+            else contextlib.nullcontext()
+        )
+        with jcm, trace_span("reservoir_journal_append"):
             self._journal.append(seq, tile, valid, wtile)
         if reg is not None:
             reg.histogram("bridge.journal_append_s").observe(
@@ -1310,6 +1392,23 @@ class DeviceStreamBridge:
                 own_epoch=self._epoch,
                 flush_seq=self._flush_seq,
             )
+            tr = _ctrace.get()
+            if tr is not None:
+                tr.point(
+                    "bridge.fenced",
+                    epoch=current,
+                    own_epoch=self._epoch,
+                    flush_seq=self._flush_seq,
+                )
+            fl = _flight.get()
+            if fl is not None:
+                fl.trigger(
+                    "fenced",
+                    epoch=current,
+                    own_epoch=self._epoch,
+                    flush_seq=self._flush_seq,
+                    checkpoint_dir=self._ckpt_dir,
+                )
             raise FencedError(
                 f"bridge fenced: checkpoint dir {self._ckpt_dir!r} is at "
                 f"primary epoch {current}, this bridge was admitted at "

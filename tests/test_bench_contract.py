@@ -163,6 +163,36 @@ def test_bench_gated_row_reports_ab_and_skip_fraction():
     assert stages["bytes_per_elem_shipped"] < stages["bytes_per_elem_raw"]
 
 
+def test_bench_trace_row_reports_attribution_reconciliation():
+    # the ISSUE-11 acceptance surface: `bench.py trace` must run the
+    # serve feed with the causal tracer at sample_every=1 + the flight
+    # recorder installed, assert IN-RUN that the per-stage attribution
+    # reconciles with the independently measured end-to-end ingest wait
+    # within 5%, and report the reconciliation error, tracing overhead,
+    # and a parse-checked postmortem bundle.  Two reps: the in-run
+    # reconciliation assert takes the best rep, so a second pass keeps a
+    # loaded CI box's scheduler noise out of a 5%-margin assert
+    rec = _run_bench(
+        {"RESERVOIR_BENCH_CONFIG": "trace", "RESERVOIR_BENCH_REPS": "2"}
+    )
+    assert "trace_causal_feed" in rec["metric"]
+    assert rec["value"] > 0
+    stages = rec["stages"]
+    for col in (
+        "traces", "spans", "measured_wait_s", "attributed_wait_s",
+        "recon_err_frac", "overhead_frac", "e2e_p50_ms", "e2e_p99_ms",
+        "stage_share", "other_share", "bundle", "bundle_spans",
+    ):
+        assert col in stages, col
+    # the row only exists if the in-run reconciliation assert held
+    assert rec["recon_err_frac"] == stages["recon_err_frac"] < 0.05
+    assert stages["traces"] > 0 and stages["bundle_spans"] > 0
+    # stage shares + other partition the e2e wait (rounding tolerance)
+    share_sum = sum(stages["stage_share"].values()) + stages["other_share"]
+    assert abs(share_sum - 1.0) < 1e-2
+    assert "serve.admission" in stages["stage_share"]
+
+
 def test_bench_rejects_unknown_config():
     env = dict(os.environ)
     env.update(RESERVOIR_BENCH_SMOKE="1", RESERVOIR_BENCH_CONFIG="nope")

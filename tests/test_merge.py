@@ -454,7 +454,15 @@ class TestTreeFoldUniformity:
     probability k/total — the end-to-end distribution gate over the whole
     production fold, not a test-local reimplementation."""
 
+    _shard_cache: dict = {}
+
     def _shards(self, R, k, D, N):
+        # deterministic in (R, k, D, N) — cached so the narrow/wide tests
+        # (which need the same fills for samples AND counts) pay the D
+        # shard fills once, not three times across the class
+        cached = self._shard_cache.get((R, k, D, N))
+        if cached is not None:
+            return cached
         step = jax.jit(al.update)  # D same-shape shard fills: one trace
         out = []
         for d in range(D):
@@ -466,6 +474,7 @@ class TestTreeFoldUniformity:
                 ),
             )
             out.append((st.samples, st.count))
+        self._shard_cache[(R, k, D, N)] = out
         return out
 
     def _merged_counts(self, stacked_c, key, R, k, D, N):

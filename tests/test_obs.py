@@ -201,6 +201,19 @@ def test_disabled_telemetry_is_zero_overhead_noop(monkeypatch, tmp_path):
     for method in ("counter", "gauge", "histogram"):
         monkeypatch.setattr(Registry, method, tripwire)
     monkeypatch.setattr(EventLog, "emit", tripwire)
+    # the causal tracer and flight recorder (ISSUE 11) follow the same
+    # discipline: with neither enabled nor installed, no Tracer or
+    # FlightRecorder method may ever be entered anywhere on these paths
+    from reservoir_tpu.obs import flight as obs_flight
+    from reservoir_tpu.obs import trace as obs_trace
+    from reservoir_tpu.obs.flight import FlightRecorder
+    from reservoir_tpu.obs.trace import Tracer
+
+    assert obs_trace.get() is None and obs_flight.get() is None
+    for method in ("span", "point", "sample"):
+        monkeypatch.setattr(Tracer, method, tripwire)
+    for method in ("record", "_tap_event", "note", "trigger", "dump"):
+        monkeypatch.setattr(FlightRecorder, method, tripwire)
     # a full checkpointing bridge stream: demux, zero-copy flush, journal
     # append, dispatch, auto-checkpoint, complete
     bridge = DeviceStreamBridge(
@@ -236,6 +249,23 @@ def test_disabled_telemetry_is_zero_overhead_noop(monkeypatch, tmp_path):
     svc.ingest("a", np.arange(32, dtype=np.int32))
     svc.snapshot("a")
     svc.close_session("a")
+    # and the sharded plane's route/kill/promote path (ISSUE 11): every
+    # causal-span and flight-trigger site on the failover critical path
+    # must short-circuit on the same module-global None checks
+    from reservoir_tpu.serve import ShardedReservoirService
+
+    cluster = ShardedReservoirService(
+        _cfg(), 2, str(tmp_path / "cl"), key=7, coalesce_bytes=64
+    )
+    keys = [f"s{i}" for i in range(4)]
+    for k in keys:
+        cluster.open_session(k)
+        cluster.ingest(k, np.arange(16, dtype=np.int32))
+    cluster.sync()
+    victim = cluster.shard_of(keys[0])
+    cluster.kill_shard(victim)
+    cluster.promote_shard(victim, reason="tripwire")
+    cluster.shutdown()
 
 
 # ----------------------------------------------------------------- event log

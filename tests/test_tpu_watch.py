@@ -417,6 +417,28 @@ def test_shard_rehearsal_post_step_registered():
     assert "shards" in tpu_watch.CONFIG_BUDGETS
 
 
+def test_postmortem_rehearsal_post_step_registered():
+    # the ISSUE-11 observability post-step: budget-capped, runs the
+    # kill→fence→promote chaos with the tracer + flight recorder live on
+    # the native backend — the auto-dumped bundle must reconstruct the
+    # causal chain and the viewer must render it — ahead of
+    # recovery_rehearsal (which stays last); the trace bench config
+    # rides the capture queue too
+    steps = {name: (cmd, timeout, env) for name, cmd, timeout, env in
+             tpu_watch.POST_STEPS}
+    cmd, timeout, env = steps["postmortem_rehearsal"]
+    assert "tests/test_trace.py" in cmd
+    assert "-k" in cmd and "postmortem or chaos" in cmd[cmd.index("-k") + 1]
+    assert 0 < timeout <= 900
+    assert env.get("RESERVOIR_TPU_TEST_PLATFORM") == "native"
+    order = [name for name, *_ in tpu_watch.POST_STEPS]
+    assert order.index("postmortem_rehearsal") < order.index(
+        "recovery_rehearsal"
+    )
+    assert "trace" in tpu_watch.DEFAULT_CONFIGS.split(",")
+    assert "trace" in tpu_watch.CONFIG_BUDGETS
+
+
 def test_parity_probe_post_step_registered(tmp_path, monkeypatch):
     # the ISSUE-7 satellite (ROADMAP item 3 tail): a budget-capped
     # on-device selftest runs FIRST in the post-step queue — parity
@@ -573,7 +595,7 @@ def test_post_step_rehearsal_sequential_gating(tmp_path, monkeypatch):
     assert [s[0] for s in remaining] == [
         "distinct_sweep", "pallas_device_tests", "algl_best_block",
         "serve_soak", "ha_rehearsal", "gated_sweep", "gated_rehearsal",
-        "shard_rehearsal", "recovery_rehearsal",
+        "shard_rehearsal", "postmortem_rehearsal", "recovery_rehearsal",
     ]
     assert committed == ["3 post-step(s) recorded"]
     rows = [

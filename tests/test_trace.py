@@ -1,0 +1,537 @@
+"""Causal tracing + flight recorder + postmortem bundles (ISSUE 11).
+
+The contract under test, in the order the ISSUE lists it:
+
+- **tracer units** — head-based sampling is a stable pure hash (the same
+  session/seq decides the same way at every site), nested spans inherit
+  the root's decision through the per-thread stack (O(1) skip under an
+  unsampled root), forced spans/points bypass sampling, and retention is
+  ring-bounded;
+- **attribution** — per-stage *self* times plus the root's own self time
+  partition each trace's end-to-end wait, so the report reconciles with
+  the e2e sum by construction (what ``bench.py trace`` then asserts
+  against an independent wall clock);
+- **flight recorder units** — bounded ring, per-reason trigger rate
+  limiting (suppressions counted, never raised), atomic parseable
+  bundles, pruned to ``keep``;
+- **the chaos acceptance** — kill -> fence -> promote on a live sharded
+  cluster with tracing on auto-produces a bundle whose span tree
+  reconstructs route -> reject -> promote -> recover, with
+  shard/session/flush_seq correlation fields intact;
+- **bit-neutrality** — journals are byte-identical with tracing +
+  recording on vs off (tracing is purely observational);
+- **the viewer** — ``tools/postmortem.py`` loads, reconstructs, and
+  renders a real bundle with no live process, and ``reservoir_top``
+  renders the live attribution panel.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+from reservoir_tpu import SamplerConfig, obs
+from reservoir_tpu.errors import FencedError, ShardUnavailable
+from reservoir_tpu.obs import flight, trace
+from reservoir_tpu.obs import registry as obs_registry
+from reservoir_tpu.obs.flight import FlightRecorder, read_bundle
+from reservoir_tpu.obs.trace import Span, Tracer, attribution
+from reservoir_tpu.serve import ReservoirService, ShardedReservoirService
+from reservoir_tpu.stream.bridge import DeviceStreamBridge
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(_REPO, "tools"))
+import postmortem  # noqa: E402
+import reservoir_top  # noqa: E402
+
+sys.path.pop(0)
+
+
+@pytest.fixture(autouse=True)
+def _planes_disabled():
+    # every test starts and ends with the whole plane off — the disabled
+    # state is the suite-wide default the zero-overhead trip-wire pins
+    trace.disable()
+    flight.uninstall()
+    obs.disable()
+    yield
+    trace.disable()
+    flight.uninstall()
+    obs.disable()
+
+
+def _cfg(R=4, B=16, k=4, **kw):
+    return SamplerConfig(
+        max_sample_size=k, num_reservoirs=R, tile_size=B, **kw
+    )
+
+
+class _FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+# ------------------------------------------------------------------- tracer
+
+
+class TestTracer:
+    def test_sampling_is_a_stable_pure_function(self):
+        a = Tracer(sample_every=4)
+        b = Tracer(sample_every=4)
+        keys = [f"s{i}" for i in range(256)] + list(range(256))
+        # pure: two tracers agree on every key; stable: repeated calls do
+        for k in keys:
+            assert a.sample(k) == b.sample(k) == a.sample(k)
+        kept = sum(a.sample(k) for k in keys)
+        assert 0 < kept < len(keys)  # 1-in-4-ish, neither all nor none
+        assert all(Tracer(sample_every=1).sample(k) for k in keys)
+
+    def test_nested_spans_inherit_the_root_decision(self):
+        tr = Tracer(sample_every=4)
+        kept = next(k for k in range(100) if tr.sample(f"s{k}"))
+        drop = next(k for k in range(100) if not tr.sample(f"s{k}"))
+        with tr.span("serve.ingest", key=f"s{kept}", session=f"s{kept}"):
+            with tr.span("serve.admission"):
+                pass
+        with tr.span("serve.ingest", key=f"s{drop}") as root:
+            assert root is None
+            with tr.span("serve.admission") as child:
+                assert child is None  # O(1) skip under the _SKIP sentinel
+        spans = tr.spans()
+        assert [s.name for s in spans] == ["serve.admission", "serve.ingest"]
+        child, root = spans
+        assert child.trace_id == root.trace_id == root.span_id
+        assert child.parent_id == root.span_id
+        assert tr.sampled == 2 and tr.skipped == 1
+
+    def test_forced_spans_and_points_bypass_sampling(self):
+        tr = Tracer(sample_every=10**9)  # nothing samples
+        with tr.span("serve.ingest", key="s0") as root:
+            assert root is None
+            # a forced marker on the reject path records even under an
+            # unsampled root — errors are never the traces we drop
+            tr.point("cluster.reject", session="s0", error="X")
+        with tr.span("ha.promote", force=True, reason="chaos"):
+            pass
+        names = [s.name for s in tr.spans()]
+        assert names == ["cluster.reject", "ha.promote"]
+        assert all(s.forced for s in tr.spans())
+        assert tr.forced == 2
+
+    def test_detached_point_starts_its_own_trace(self):
+        tr = Tracer(sample_every=1)
+        with tr.span("serve.ingest", key="a") as root:
+            attached = tr.point("bridge.fenced", epoch=3)
+            detached = tr.point("serve.coalesce_wait", detached=True)
+            assert attached.trace_id == root.trace_id
+            assert detached.trace_id != root.trace_id
+            assert detached.parent_id is None
+
+    def test_retention_is_ring_bounded(self):
+        tr = Tracer(sample_every=1, capacity=8)
+        for i in range(50):
+            with tr.span("serve.ingest", key=i, i=i):
+                pass
+        spans = tr.spans()
+        assert len(spans) == 8
+        assert [s.fields["i"] for s in spans] == list(range(42, 50))
+        assert tr.snapshot()["retained"] == 8
+        assert tr.snapshot()["sampled"] == 50
+        tr.clear()
+        assert tr.spans() == []
+
+    def test_span_fields_and_late_attachment_round_trip(self):
+        tr = Tracer(sample_every=1)
+        with tr.span("serve.ingest", key="s1", session="s1") as sp:
+            sp.fields["flush_seq"] = 7
+        d = tr.spans()[0].to_dict()
+        assert d["fields"] == {"session": "s1", "flush_seq": 7}
+        assert d["duration_s"] >= 0.0
+
+
+# -------------------------------------------------------------- attribution
+
+
+def _tree_tracer():
+    """A deterministic span tree on a fake clock:
+
+    serve.ingest (7.5s total)
+      serve.admission (2s)
+      serve.ship (4s)
+        bridge.journal (3s)
+
+    Self times: admission 2, ship 1, journal 3, other (root self) 1.5 —
+    partitioning e2e = 7.5 exactly.
+    """
+    clk = _FakeClock(0.0)
+    tr = Tracer(sample_every=1, clock=clk, wall=lambda: 0.0)
+    with tr.span("serve.ingest", key="s1", session="s1"):
+        clk.t += 1.0
+        with tr.span("serve.admission"):
+            clk.t += 2.0
+        with tr.span("serve.ship"):
+            clk.t += 1.0
+            with tr.span("bridge.journal", flush_seq=3):
+                clk.t += 3.0
+        clk.t += 0.5
+    return tr
+
+
+def test_attribution_self_times_partition_e2e_exactly():
+    att = attribution(_tree_tracer().spans())
+    assert att["traces"] == 1 and att["spans"] == 4
+    assert att["e2e_s"]["sum"] == pytest.approx(7.5)
+    assert att["stages"]["serve.admission"]["sum_s"] == pytest.approx(2.0)
+    assert att["stages"]["serve.ship"]["sum_s"] == pytest.approx(1.0)
+    assert att["stages"]["bridge.journal"]["sum_s"] == pytest.approx(3.0)
+    assert att["other"]["sum_s"] == pytest.approx(1.5)
+    covered = (
+        sum(s["sum_s"] for s in att["stages"].values())
+        + att["other"]["sum_s"]
+    )
+    # the reconciliation bench.py trace asserts, here in its pure form
+    assert covered == pytest.approx(att["e2e_s"]["sum"], abs=1e-12)
+    shares = [s["share"] for s in att["stages"].values()]
+    assert sum(shares) + att["other"]["share"] == pytest.approx(1.0)
+    worst = att["critical_path"][0]
+    assert worst["fields"]["session"] == "s1"
+    assert [s["name"] for s in worst["stages"]] == [
+        "serve.admission", "serve.ship", "bridge.journal",
+    ]
+    assert worst["stages"][2]["flush_seq"] == 3
+
+
+def test_attribution_scopes_to_the_named_root():
+    tr = _tree_tracer()
+    tr.point("bridge.fenced", epoch=2)  # its own trace: no serve.ingest
+    att = attribution(tr.spans())
+    assert att["traces"] == 1  # the fenced marker trace is excluded
+    # an absent root name attributes nothing (e.g. a cluster-rooted
+    # report over a clusterless run)
+    assert attribution(tr.spans(), root="cluster.ingest")["traces"] == 0
+    att2 = attribution([], root="serve.ingest")
+    assert att2["traces"] == 0 and att2["e2e_s"]["sum"] == 0.0
+
+
+# ------------------------------------------------------------------- flight
+
+
+class TestFlightRecorder:
+    def test_ring_is_bounded_oldest_first(self, tmp_path):
+        clk = _FakeClock()
+        fr = FlightRecorder(str(tmp_path), capacity=4, clock=clk)
+        for i in range(10):
+            clk.t += 1
+            fr.note("n", i=i)
+        tail = fr.tail()
+        assert len(tail) == 4
+        assert [r["i"] for r in tail] == [6, 7, 8, 9]
+        assert [r["kind"] for r in tail] == ["note"] * 4
+
+    def test_trigger_rate_limits_per_reason(self, tmp_path):
+        clk = _FakeClock()
+        fr = FlightRecorder(str(tmp_path), min_interval_s=5.0, clock=clk)
+        assert fr.trigger("fenced", epoch=1) is not None
+        assert fr.trigger("fenced", epoch=2) is None  # suppressed
+        assert fr.trigger("promotion") is not None  # other reason: fresh
+        assert fr.suppressed == 1
+        clk.t += 6.0
+        assert fr.trigger("fenced", epoch=3) is not None
+        assert fr.dumps == 3
+
+    def test_bundles_are_parseable_atomic_and_pruned(self, tmp_path):
+        fr = FlightRecorder(str(tmp_path), keep=2, min_interval_s=0.0)
+        fr.note("before", x=1)
+        paths = [fr.dump(f"reason-{i}", i=i) for i in range(4)]
+        for p in paths:
+            assert os.path.basename(p).startswith("postmortem-")
+        kept = fr.bundles()
+        assert len(kept) == 2  # pruned to keep
+        assert kept[-1] == paths[-1]
+        bundle = read_bundle(kept[-1])
+        assert bundle["reason"] == "reason-3"
+        assert bundle["context"] == {"i": 3}
+        assert any(r.get("note") == "before" for r in bundle["events"])
+        # no temp files left behind (mkstemp + os.replace)
+        assert all(
+            n.startswith("postmortem-") for n in os.listdir(str(tmp_path))
+        )
+
+    def test_installed_recorder_taps_registry_emit(self, tmp_path):
+        fr = flight.install(dir=str(tmp_path))
+        # no Registry enabled, no EventLog attached: emit still lands in
+        # the ring — that is the always-on part of the flight recorder
+        assert obs_registry.get() is None
+        obs_registry.emit("bridge.fenced", epoch=5, flush_seq=2)
+        tail = fr.tail()
+        assert tail and tail[-1]["event"] == "bridge.fenced"
+        assert tail[-1]["epoch"] == 5
+        flight.uninstall()
+        obs_registry.emit("bridge.fenced", epoch=6)
+        assert len(fr.tail()) == len(tail)  # tap removed with uninstall
+
+    def test_bundle_embeds_tracer_and_telemetry(self, tmp_path):
+        obs.enable(obs.Registry())
+        tr = trace.enable(sample_every=1)
+        fr = flight.install(
+            dir=str(tmp_path), config={"root_span": "serve.ingest"}
+        )
+        svc = ReservoirService(_cfg(), key=3)
+        svc.open_session("a")
+        svc.ingest("a", np.arange(64, dtype=np.int32))
+        svc.sync()
+        svc.close_session("a")
+        bundle = read_bundle(fr.dump("manual"))
+        assert bundle["tracer"]["retained"] == len(bundle["spans"]) > 0
+        assert bundle["config"] == {"root_span": "serve.ingest"}
+        att = bundle["attribution"]
+        assert att["root"] == "serve.ingest" and att["traces"] > 0
+        assert "serve.admission" in att["stages"]
+        assert "counters" in bundle["telemetry"]
+        assert tr.snapshot()["sampled"] > 0
+
+
+# ----------------------------------------------------- live service tracing
+
+
+def test_service_ingest_produces_reconciling_causal_traces(tmp_path):
+    with trace.active(sample_every=1) as tr:
+        svc = ReservoirService(_cfg(), key=5, coalesce_bytes=64)
+        for i in range(4):
+            svc.open_session(f"s{i}")
+        for _ in range(3):
+            for i in range(4):
+                svc.ingest(f"s{i}", np.arange(32, dtype=np.int32))
+        svc.sync()
+        for i in range(4):
+            svc.close_session(f"s{i}")
+        spans = tr.spans()
+    roots = [s for s in spans if s.name == "serve.ingest"]
+    assert len(roots) == 12  # every ingest call traced at 1-in-1
+    assert all(s.fields.get("session") in {f"s{i}" for i in range(4)}
+               for s in roots)
+    names = {s.name for s in spans}
+    assert {"serve.ingest", "serve.admission", "serve.ship"} <= names
+    att = attribution(spans)
+    covered = (
+        sum(s["sum_s"] for s in att["stages"].values())
+        + att["other"]["sum_s"]
+    )
+    assert covered == pytest.approx(att["e2e_s"]["sum"], rel=1e-9)
+
+
+def test_sampling_keeps_the_same_sessions_at_every_site(tmp_path):
+    with trace.active(sample_every=3) as tr:
+        svc = ReservoirService(_cfg(), key=5)
+        keys = [f"s{i}" for i in range(12)]
+        for k in keys:
+            svc.open_session(k)
+            svc.ingest(k, np.arange(16, dtype=np.int32))
+        svc.sync()
+        kept = {s.fields["session"] for s in tr.spans()
+                if s.name == "serve.ingest"}
+    want = {k for k in keys if Tracer(sample_every=3).sample(k)}
+    assert kept == want and 0 < len(kept) < len(keys)
+
+
+# ----------------------------------------------------------- bit-neutrality
+
+
+def _run_bridge(ck_dir):
+    bridge = DeviceStreamBridge(
+        _cfg(), key=9, checkpoint_dir=ck_dir, checkpoint_every=2
+    )
+    rng = np.random.default_rng(7)
+    for _ in range(5):
+        for r in range(3):
+            bridge.push(r, rng.integers(0, 1 << 30, 16).astype(np.int32))
+    samples = [np.asarray(s) for s in bridge.complete()]
+    return samples, open(
+        os.path.join(ck_dir, "journal.bin"), "rb"
+    ).read()
+
+
+def test_journals_byte_identical_with_tracing_on_and_off(tmp_path):
+    samples_off, journal_off = _run_bridge(str(tmp_path / "off"))
+    trace.enable(sample_every=1)
+    flight.install(dir=str(tmp_path / "pm"))
+    try:
+        samples_on, journal_on = _run_bridge(str(tmp_path / "on"))
+    finally:
+        flight.uninstall()
+        trace.disable()
+    # tracing + recording are purely observational: the durable artifact
+    # and the reservoir contents are bit-identical either way
+    assert journal_on == journal_off and len(journal_on) > 0
+    for got, want in zip(samples_on, samples_off):
+        np.testing.assert_array_equal(got, want)
+
+
+# ------------------------------------------------------- chaos / postmortem
+
+
+def test_chaos_kill_fence_promote_autoproduces_causal_postmortem(tmp_path):
+    """The ISSUE-11 acceptance: chaos kill -> fence -> promote on a live
+    cluster with tracing on auto-produces a postmortem bundle whose span
+    tree reconstructs route -> reject -> promote -> recover with the
+    shard/session/flush_seq correlation fields intact."""
+    pm_dir = str(tmp_path / "pm")
+    tr = trace.enable(sample_every=1, capacity=1 << 14)
+    flight.install(
+        dir=pm_dir, min_interval_s=0.0,
+        config={"root_span": "serve.ingest"},
+    )
+    try:
+        cluster = ShardedReservoirService(
+            _cfg(), 2, str(tmp_path / "cl"), key=5, coalesce_bytes=64
+        )
+        keys = [f"s{i}" for i in range(8)]
+        for k in keys:
+            cluster.open_session(k)
+            cluster.ingest(k, np.arange(16, dtype=np.int32))
+        cluster.sync()
+        cluster.poll()
+        victim = cluster.shard_of(keys[0])
+        vkey = next(k for k in keys if cluster.shard_of(k) == victim)
+        zombie = cluster.kill_shard(victim)
+        with pytest.raises(ShardUnavailable):
+            cluster.ingest(vkey, np.arange(8, dtype=np.int32))
+        cluster.promote_shard(victim, reason="chaos kill")  # auto-bundle
+        assert flight.get().dumps >= 1  # the promotion trigger fired
+
+        # the no-standby half of the story: kill -> stop-the-world
+        # recover on a second cluster, same tracer (monotonic ordering)
+        cl2 = ShardedReservoirService(
+            _cfg(), 2, str(tmp_path / "cl2"), key=5, standby=False,
+            coalesce_bytes=64,
+        )
+        k2 = next(f"r{i}" for i in range(1000)
+                  if cl2.shard_of(f"r{i}") == 0)
+        cl2.open_session(k2)
+        cl2.ingest(k2, np.arange(24, dtype=np.int32))
+        cl2.sync()
+        cl2.kill_shard(0)
+        cl2.recover_shard(0)
+
+        # the fenced zombie's probe: forced marker + "fenced" auto-bundle
+        with pytest.raises(FencedError):
+            zombie.ingest(vkey, np.arange(64, dtype=np.int32))
+            zombie.sync()
+        bundles = flight.get().bundles()
+        assert bundles, "no postmortem bundle was auto-produced"
+        cluster.shutdown()
+        cl2.shutdown()
+    finally:
+        flight.uninstall()
+        trace.disable()
+    reasons = {read_bundle(p)["reason"] for p in bundles}
+    assert "promotion" in reasons
+    bundle = read_bundle(bundles[-1])  # newest: has the full history
+    spans = bundle["spans"]
+    names = {s["name"] for s in spans}
+    assert {
+        "cluster.ingest", "cluster.route", "cluster.reject",
+        "serve.ingest", "shard.promote", "ha.promote", "shard.recover",
+    } <= names
+
+    start = {
+        n: min(s["start_s"] for s in spans if s["name"] == n)
+        for n in ("cluster.route", "cluster.reject", "shard.promote",
+                  "shard.recover")
+    }
+    # the causal story, in monotonic order
+    assert (start["cluster.route"] < start["cluster.reject"]
+            < start["shard.promote"] < start["shard.recover"])
+    reject = next(s for s in spans if s["name"] == "cluster.reject")
+    assert reject["fields"]["session"] == vkey
+    assert reject["fields"]["shard"] == victim
+    assert reject["forced"] is True
+    promote = next(s for s in spans if s["name"] == "shard.promote")
+    assert promote["fields"]["shard"] == victim
+    assert promote["fields"]["flush_seq"] >= 0
+    # the promotion span nests the controller's epoch-fenced promote
+    ha = next(s for s in spans if s["name"] == "ha.promote")
+    assert ha["parent_id"] == promote["span_id"]
+    assert ha["trace_id"] == promote["trace_id"]
+    recover = next(s for s in spans if s["name"] == "shard.recover")
+    assert recover["fields"]["flush_seq"] >= 0
+    # the fenced marker carries the epochs that explain the fence
+    fenced = [s for s in spans if s["name"] == "bridge.fenced"]
+    assert fenced and fenced[-1]["fields"]["epoch"] > (
+        fenced[-1]["fields"]["own_epoch"]
+    )
+    # ring events landed too: the bundle is events + spans, correlated
+    assert any(r.get("event") == "ha.promote_decision"
+               for r in bundle["events"])
+    assert any(r.get("note") == "shard.recovered"
+               for r in bundle["events"])
+
+
+# ------------------------------------------------------------------- viewer
+
+
+@pytest.fixture()
+def _bundle_dir(tmp_path):
+    """A real bundle from a small traced run (shared by viewer tests)."""
+    pm = str(tmp_path / "pm")
+    obs.enable(obs.Registry())
+    trace.enable(sample_every=1)
+    fr = flight.install(dir=pm, config={"root_span": "serve.ingest"})
+    svc = ReservoirService(_cfg(), key=3, coalesce_bytes=64)
+    svc.open_session("a")
+    for _ in range(3):
+        svc.ingest("a", np.arange(32, dtype=np.int32))
+    svc.sync()
+    svc.close_session("a")
+    fr.note("chaos.action", what="manual dump")
+    path = fr.dump("viewer_test")
+    flight.uninstall()
+    trace.disable()
+    obs.disable()
+    return pm, path
+
+
+def test_postmortem_viewer_loads_and_renders(_bundle_dir):
+    pm, path = _bundle_dir
+    bundle = postmortem.load(pm)  # directory -> newest bundle
+    assert bundle["_path"] == path
+    roots = postmortem.span_tree(bundle["spans"])
+    assert roots and all("children" in r for r in roots)
+    ingest = next(r for r in roots if r["name"] == "serve.ingest")
+    assert any(c["name"] == "serve.admission" for c in ingest["children"])
+    out = postmortem.render(bundle)
+    assert "reason='viewer_test'" in out
+    assert "span tree" in out and "serve.ingest" in out
+    assert "attribution" in out and "serve.admission" in out
+    assert "chaos.action" in out  # the event tail
+    assert "tracer:" in out
+
+
+def test_postmortem_viewer_cli_contract(_bundle_dir, capsys):
+    pm, path = _bundle_dir
+    assert postmortem.main([path]) == 0
+    assert "postmortem #" in capsys.readouterr().out
+    assert postmortem.main([pm, "--json", "attribution"]) == 0
+    att = json.loads(capsys.readouterr().out)
+    assert att["root"] == "serve.ingest" and att["traces"] > 0
+    assert postmortem.main([path, "--json", "nope"]) == 2
+    assert postmortem.main([os.path.join(pm, "missing.json")]) == 2
+
+
+def test_reservoir_top_renders_trace_panel():
+    tel = {"trace": attribution(_tree_tracer().spans())}
+    lines = reservoir_top._trace_lines(tel)
+    text = "\n".join(lines)
+    assert "trace: 1 traces (4 spans)" in text
+    assert "serve.admission" in text and "bridge.journal" in text
+    assert "(other / uninstrumented)" in text
+    assert "worst trace" in text and "serve.ship" in text
+    assert reservoir_top._trace_lines(None) == []
+    assert reservoir_top._trace_lines({"trace": {}}) == []

@@ -7,7 +7,6 @@ import os
 
 import numpy as np
 
-from reservoir_tpu import ReservoirEngine, SamplerConfig
 from reservoir_tpu.utils.tracing import maybe_profile, profile_capture, trace_span
 
 
@@ -18,26 +17,29 @@ def test_trace_span_is_reentrant_noop_safe():
 
 
 def test_profile_capture_writes_xplane(tmp_path):
+    # a tiny device computation inside the capture: the contract under
+    # test is the harness (start/stop, xplane on disk, trace_span safe
+    # inside), not the engine — a full engine compile here costs ~15 s
+    # of tier-1 budget for no extra coverage (the engine's own spans are
+    # exercised by the kernel/bridge suites)
+    import jax.numpy as jnp
+
     log_dir = str(tmp_path / "trace")
-    eng = ReservoirEngine(
-        SamplerConfig(max_sample_size=4, num_reservoirs=2), key=0
-    )
     with profile_capture(log_dir) as d:
         with trace_span("test_region"):
-            eng.sample(np.arange(2 * 16, dtype=np.int32).reshape(2, 16))
-            eng.result_arrays()
+            np.asarray(jnp.arange(16, dtype=jnp.int32) * 2)
     captured = glob.glob(os.path.join(d, "**", "*.xplane.pb"), recursive=True)
     assert captured, f"no xplane capture under {d}"
 
 
 def test_maybe_profile_respects_env(tmp_path, monkeypatch):
+    import jax.numpy as jnp
+
     monkeypatch.delenv("RESERVOIR_TPU_TRACE_DIR", raising=False)
     with maybe_profile():  # no env: no-op
         pass
     log_dir = str(tmp_path / "envtrace")
     monkeypatch.setenv("RESERVOIR_TPU_TRACE_DIR", log_dir)
     with maybe_profile():
-        ReservoirEngine(
-            SamplerConfig(max_sample_size=2, num_reservoirs=1), key=1
-        ).sample(np.zeros((1, 4), np.int32))
+        np.asarray(jnp.zeros((4,), jnp.int32) + 1)
     assert glob.glob(os.path.join(log_dir, "**", "*.xplane.pb"), recursive=True)

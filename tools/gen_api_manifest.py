@@ -59,7 +59,9 @@ PUBLIC_MODULES = [
     "reservoir_tpu.obs",
     "reservoir_tpu.obs.events",
     "reservoir_tpu.obs.export",
+    "reservoir_tpu.obs.flight",
     "reservoir_tpu.obs.registry",
+    "reservoir_tpu.obs.trace",
     "reservoir_tpu.oracle",
     "reservoir_tpu.parallel",
     "reservoir_tpu.parallel.merge",

@@ -1,0 +1,307 @@
+"""postmortem: render a flight-recorder bundle (ISSUE 11), no jax import.
+
+A postmortem bundle is one atomic JSON file written by
+:class:`reservoir_tpu.obs.flight.FlightRecorder` at the moment something
+went wrong (promotion, fence, watchdog trip, SLO page — or a manual
+``dump()``).  This tool is the 3am half of the plane: it reads ONLY the
+bundle file (plain JSON; safe on any machine, no live process, no jax)
+and renders:
+
+- the header — reason, trigger context, recorder config, dump sequence;
+- the **span tree** — every retained causal trace, roots ordered by
+  start time, children nested under their parents with durations and the
+  correlation fields (``session``/``shard``/``flush_seq``/``epoch``)
+  that join spans against journal frames and event records;
+- the **latency attribution** — per-stage share of the end-to-end ingest
+  wait plus the worst traces' critical paths;
+- the **event tail** — the flight ring's last events/notes, oldest
+  first, with the structured correlation fields inline;
+- the heartbeat / fence-epoch / SLO state captured at dump time.
+
+Usage::
+
+    python tools/postmortem.py BUNDLE.json [--events 20] [--traces 10]
+    python tools/postmortem.py /path/to/bundles/   # newest bundle in dir
+
+``--json SECTION`` prints one raw section (``attribution``, ``spans``,
+``events``, ``telemetry``, ...) for piping into jq.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from typing import Any, Dict, List, Optional
+
+__all__ = ["load", "span_tree", "render", "main"]
+
+_BUNDLE_PREFIX = "postmortem-"
+
+
+def load(target: str) -> dict:
+    """Parse a bundle file — or, given a directory, its newest bundle."""
+    if os.path.isdir(target):
+        names = sorted(
+            n
+            for n in os.listdir(target)
+            if n.startswith(_BUNDLE_PREFIX) and n.endswith(".json")
+        )
+        if not names:
+            raise FileNotFoundError(
+                f"{target!r}: no {_BUNDLE_PREFIX}*.json bundles"
+            )
+        target = os.path.join(target, names[-1])
+    with open(target, encoding="utf-8") as fh:
+        bundle = json.load(fh)
+    bundle.setdefault("_path", target)
+    return bundle
+
+
+def span_tree(spans: List[dict]) -> List[dict]:
+    """Reconstruct the forest: spans grouped by trace, nested by
+    ``parent_id``, siblings ordered by ``start_s``.  Returns the roots
+    (each with a ``children`` list), ordered by start time — orphans
+    (parent fell out of the ring) are promoted to roots of their trace."""
+    by_id: Dict[int, dict] = {}
+    for s in spans:
+        node = dict(s)
+        node["children"] = []
+        by_id[node["span_id"]] = node
+    roots: List[dict] = []
+    for node in by_id.values():
+        parent = (
+            by_id.get(node["parent_id"])
+            if node.get("parent_id") is not None
+            else None
+        )
+        if parent is not None:
+            parent["children"].append(node)
+        else:
+            roots.append(node)
+    for node in by_id.values():
+        node["children"].sort(key=lambda n: n.get("start_s", 0.0))
+    roots.sort(key=lambda n: n.get("start_s", 0.0))
+    return roots
+
+
+def _fmt_ms(v: float) -> str:
+    return f"{v * 1e3:.3f}ms"
+
+
+def _fields(node: dict) -> str:
+    fields = node.get("fields") or {}
+    parts = [f"{k}={fields[k]}" for k in sorted(fields)]
+    if node.get("forced"):
+        parts.append("forced")
+    return f"  [{', '.join(parts)}]" if parts else ""
+
+
+def _tree_lines(roots: List[dict], limit: int) -> List[str]:
+    lines: List[str] = []
+    shown = 0
+    for root in roots:
+        if shown >= limit:
+            lines.append(f"... ({len(roots) - shown} more traces)")
+            break
+        shown += 1
+        stack = [(root, 0)]
+        while stack:
+            node, depth = stack.pop()
+            lines.append(
+                f"{'  ' * depth}{node['name']:<{max(1, 30 - 2 * depth)}} "
+                f"{_fmt_ms(float(node.get('duration_s', 0.0))):>12}"
+                f"{_fields(node)}"
+            )
+            for child in reversed(node["children"]):
+                stack.append((child, depth + 1))
+    return lines
+
+
+def _attribution_lines(att: Optional[dict]) -> List[str]:
+    if not att or not att.get("traces"):
+        return []
+    lines = [
+        "",
+        f"attribution (root={att.get('root')!r}): {att['traces']} traces, "
+        f"e2e p50 {_fmt_ms(att['e2e_s']['p50'])} "
+        f"p99 {_fmt_ms(att['e2e_s']['p99'])} "
+        f"sum {_fmt_ms(att['e2e_s']['sum'])}",
+        f"  {'stage':<24}{'count':>7}{'p50':>12}{'p99':>12}{'share':>8}",
+    ]
+    stages = att.get("stages") or {}
+    for name in sorted(
+        stages, key=lambda n: stages[n].get("share", 0.0), reverse=True
+    ):
+        st = stages[name]
+        lines.append(
+            f"  {name:<24}{int(st.get('count', 0)):>7}"
+            f"{_fmt_ms(float(st.get('p50_s', 0.0))):>12}"
+            f"{_fmt_ms(float(st.get('p99_s', 0.0))):>12}"
+            f"{float(st.get('share', 0.0)) * 100:>7.1f}%"
+        )
+    other = att.get("other") or {}
+    lines.append(
+        f"  {'(other)':<24}{'':>7}{'':>12}{'':>12}"
+        f"{float(other.get('share', 0.0)) * 100:>7.1f}%"
+    )
+    for w in att.get("critical_path") or []:
+        path = " -> ".join(
+            f"{s['name']} {_fmt_ms(float(s['duration_s']))}"
+            for s in w.get("stages", [])
+        )
+        lines.append(
+            f"  worst trace {w.get('trace_id')} "
+            f"({_fmt_ms(float(w.get('e2e_s', 0.0)))}): "
+            f"{path or '(no child stages)'}"
+        )
+    return lines
+
+
+def _event_lines(events: List[dict], limit: int) -> List[str]:
+    if not events:
+        return []
+    tail = events[-limit:]
+    lines = ["", f"event tail ({len(tail)} of {len(events)}):"]
+    for rec in tail:
+        ts = rec.get("ts")
+        stamp = (
+            time.strftime("%H:%M:%S", time.localtime(float(ts)))
+            if ts is not None
+            else "--:--:--"
+        )
+        kind = rec.get("kind", "?")
+        name = rec.get("event") or rec.get("note") or "?"
+        extras = ", ".join(
+            f"{k}={v}"
+            for k, v in sorted(rec.items())
+            if k not in ("ts", "kind", "event", "note")
+        )
+        lines.append(
+            f"  {stamp} {kind:<6} {name:<24}{extras}"
+        )
+    return lines
+
+
+def _state_lines(bundle: dict) -> List[str]:
+    lines: List[str] = []
+    hb = bundle.get("heartbeat")
+    if hb is not None:
+        lines.append(
+            f"heartbeat: ts={hb.get('ts')} epoch={hb.get('epoch')} "
+            f"seq={hb.get('seq')} watchdog_trips={hb.get('watchdog_trips')} "
+            f"rejections={hb.get('rejections')}"
+        )
+    if bundle.get("epoch") is not None:
+        lines.append(f"persisted fence epoch: {bundle['epoch']}")
+    tel = bundle.get("telemetry") or {}
+    verdicts = (tel.get("slo") or {}).get("verdicts") or {}
+    if verdicts:
+        worst = (tel.get("slo") or {}).get("worst", "?")
+        row = ", ".join(
+            f"{name}={verdicts[name].get('verdict', '?')}"
+            for name in sorted(verdicts)
+        )
+        lines.append(f"slo (worst={worst}): {row}")
+    tracer = bundle.get("tracer")
+    if tracer is not None:
+        lines.append(
+            f"tracer: sample_every={tracer.get('sample_every')} "
+            f"retained={tracer.get('retained')} "
+            f"sampled={tracer.get('sampled')} "
+            f"skipped={tracer.get('skipped')} forced={tracer.get('forced')}"
+        )
+    return lines
+
+
+def render(
+    bundle: dict, *, events: int = 20, traces: int = 10
+) -> str:
+    """One plain-text postmortem (pure function of the bundle dict)."""
+    ts = bundle.get("ts")
+    stamp = (
+        time.strftime("%Y-%m-%d %H:%M:%S", time.localtime(float(ts)))
+        if ts is not None
+        else "?"
+    )
+    context = bundle.get("context") or {}
+    lines = [
+        f"postmortem #{bundle.get('seq', '?')} — "
+        f"reason={bundle.get('reason', '?')!r} @ {stamp}",
+    ]
+    if context:
+        lines.append(
+            "context: "
+            + "  ".join(f"{k}={context[k]}" for k in sorted(context))
+        )
+    config = bundle.get("config") or {}
+    if config:
+        lines.append(
+            "config: "
+            + "  ".join(f"{k}={config[k]}" for k in sorted(config))
+        )
+    lines.extend(_state_lines(bundle))
+    spans = bundle.get("spans") or []
+    if spans:
+        roots = span_tree(spans)
+        lines.append("")
+        lines.append(
+            f"span tree ({len(spans)} spans, {len(roots)} roots):"
+        )
+        lines.extend(_tree_lines(roots, traces))
+    lines.extend(_attribution_lines(bundle.get("attribution")))
+    lines.extend(_event_lines(bundle.get("events") or [], events))
+    if len(lines) == 1:
+        lines.append("(empty bundle)")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument(
+        "target",
+        help="a postmortem bundle file, or a directory of bundles "
+        "(renders the newest)",
+    )
+    ap.add_argument(
+        "--events", type=int, default=20, help="event-tail rows to show"
+    )
+    ap.add_argument(
+        "--traces", type=int, default=10, help="span-tree roots to show"
+    )
+    ap.add_argument(
+        "--json",
+        default=None,
+        metavar="SECTION",
+        help="print one raw bundle section as JSON (e.g. attribution, "
+        "spans, events, telemetry) instead of the rendered view",
+    )
+    args = ap.parse_args(argv)
+    try:
+        bundle = load(args.target)
+    except (OSError, json.JSONDecodeError, ValueError) as e:
+        print(f"postmortem: cannot load {args.target!r}: {e}", file=sys.stderr)
+        return 2
+    if args.json is not None:
+        if args.json not in bundle:
+            print(
+                f"postmortem: no section {args.json!r} "
+                f"(have: {', '.join(sorted(bundle))})",
+                file=sys.stderr,
+            )
+            return 2
+        json.dump(bundle[args.json], sys.stdout, indent=2, default=str)
+        print()
+        return 0
+    print(render(bundle, events=args.events, traces=args.traces))
+    return 0
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except BrokenPipeError:  # `postmortem.py ... | head` closing early
+        os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
+        sys.exit(0)

@@ -191,6 +191,52 @@ def _slo_lines(tel: Optional[dict]) -> list:
     return lines
 
 
+def _trace_lines(tel: Optional[dict]) -> list:
+    """The causal-tracing panel (ISSUE 11): per-stage share of the
+    end-to-end ingest wait from the embedded attribution report, plus the
+    worst trace's critical path — which stage ate the p99, live."""
+    att = (tel or {}).get("trace") or {}
+    if not att.get("traces"):
+        return []
+    lines = ["", (
+        f"trace: {att['traces']} traces ({att.get('spans', 0)} spans)  "
+        f"e2e p50 {_fmt_ms(att['e2e_s']['p50']).strip()}"
+        f"  p99 {_fmt_ms(att['e2e_s']['p99']).strip()}"
+    )]
+    lines.append(
+        f"{'stage':<24}{'count':>8}{'p50':>12}{'p99':>12}{'share':>8}"
+    )
+    stages = att.get("stages") or {}
+    for name in sorted(
+        stages, key=lambda n: stages[n].get("share", 0.0), reverse=True
+    ):
+        st = stages[name]
+        lines.append(
+            f"{name:<24}{int(st.get('count', 0)):>8}"
+            f"{_fmt_ms(float(st.get('p50_s', 0.0))):>12}"
+            f"{_fmt_ms(float(st.get('p99_s', 0.0))):>12}"
+            f"{float(st.get('share', 0.0)) * 100:>7.1f}%"
+        )
+    other = att.get("other") or {}
+    lines.append(
+        f"{'(other / uninstrumented)':<24}{'':>8}{'':>12}{'':>12}"
+        f"{float(other.get('share', 0.0)) * 100:>7.1f}%"
+    )
+    worst = (att.get("critical_path") or [])
+    if worst:
+        w = worst[0]
+        path = " -> ".join(
+            f"{s['name']} {_fmt_ms(float(s['duration_s'])).strip()}"
+            for s in w.get("stages", [])
+        )
+        lines.append(
+            f"worst trace {w.get('trace_id')} "
+            f"({_fmt_ms(float(w.get('e2e_s', 0.0))).strip()}): "
+            f"{path or '(no child stages)'}"
+        )
+    return lines
+
+
 def _shard_lines(status: dict) -> list:
     """The per-shard panel (ISSUE 9): one row per shard from a cluster
     heartbeat — alive/epoch/seq/sessions/standby-lag/SLO — plus a banner
@@ -255,6 +301,7 @@ def render(status: dict, prev: Optional[dict] = None) -> str:
         )
     tel = status.get("telemetry")
     lines.extend(_slo_lines(tel))
+    lines.extend(_trace_lines(tel))
     if tel:
         hists = tel.get("histograms", {})
         rows = [

@@ -100,6 +100,10 @@ CONFIG_BUDGETS: dict[str, tuple[float, dict[str, str]]] = {
     # kill-one-shard failover time + merged-snapshot latency on the real
     # backend; host-path config, no parity selftest
     "shards": (420.0, {"RESERVOIR_BENCH_SELFTEST": "0"}),
+    # the ISSUE-11 causal tracer: serve feed at sample_every=1 with the
+    # flight recorder live, attribution-vs-wall reconciliation asserted
+    # in-run; host-path config, no parity selftest
+    "trace": (420.0, {"RESERVOIR_BENCH_SELFTEST": "0"}),
 }
 
 # r5 priority order (VERDICT r4): parity-attached headline first, then
@@ -109,7 +113,7 @@ CONFIG_BUDGETS: dict[str, tuple[float, dict[str, str]]] = {
 # a CONFIG_BUDGETS row (an unbudgeted config can burn a whole window).
 DEFAULT_CONFIGS = (
     "algl,algl_chunk1024,algl_chunk0,distinct,weighted,stream,bridge,"
-    "bridge_serial,gated,serve,ha,traffic,shards,algl_B4096"
+    "bridge_serial,gated,serve,ha,traffic,shards,trace,algl_B4096"
 )
 
 def _now() -> str:
@@ -527,6 +531,26 @@ POST_STEPS: list[tuple[str, list[str], float, dict]] = [
             "soak or killed or fenced",
         ],
         900.0,
+        {"RESERVOIR_TPU_TEST_PLATFORM": "native"},
+    ),
+    (
+        # postmortem rehearsal (ISSUE 11): kill->fence->promote chaos with
+        # the tracer + flight recorder live — the auto-dumped bundle must
+        # reconstruct route->reject->promote->recover causally, and the
+        # viewer must render it — run against the real backend,
+        # budget-capped like its siblings
+        "postmortem_rehearsal",
+        [
+            sys.executable,
+            "-m",
+            "pytest",
+            "tests/test_trace.py",
+            "-q",
+            "--no-header",
+            "-k",
+            "postmortem or chaos",
+        ],
+        600.0,
         {"RESERVOIR_TPU_TEST_PLATFORM": "native"},
     ),
     (
