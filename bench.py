@@ -1018,6 +1018,231 @@ def _bench_shards(S, k, B, steps, reps):
     return times, stages
 
 
+def _bench_merge(S, k, B, steps, reps):
+    """Device-vs-host merge A/B + live-migration rehearsal (ISSUE 12).
+
+    Two currencies in one row.  **Merge A/B**: the same cross-shard
+    ``merged_snapshot`` groups read once through the host pairwise tree
+    (``cluster.merge_s``) and once through the device collective
+    (``cluster.merge_device_s`` — Pallas ring on TPU, XLA ``all_gather``
+    elsewhere); bit-identity of every pair is asserted in-run (the same
+    node-numbered tree, so a mismatch is a bug, not noise), and the host
+    path is asserted trace-free after its first merge
+    (``host_pairwise_trace_count`` — the memoized pairwise jit cannot
+    re-trace per call).  **Migration rehearsal**: >= 20 randomized live
+    ``migrate()`` calls interleaved with open-loop ``tools/loadgen.py``
+    traffic slices; each migration probes for stale reads — the synced
+    pre-migration snapshot must equal the first post-migration read
+    bit-for-bit, the destination must own the lease, and the source must
+    refuse the key — and the row carries ``stale_reads`` (must be 0) +
+    migration latency quantiles (``cluster.migrate_s``).
+
+    Env knobs: RESERVOIR_BENCH_SHARDS (default 4),
+    RESERVOIR_BENCH_MIGRATIONS (default 24),
+    RESERVOIR_BENCH_MERGE_IMPL (device impl: auto|xla|pallas)."""
+    import shutil
+    import tempfile
+
+    sys.path.insert(0, os.path.join(_REPO, "tools"))
+    try:
+        import loadgen
+    finally:
+        sys.path.pop(0)
+    from reservoir_tpu import SamplerConfig, obs
+    from reservoir_tpu.errors import UnknownSessionError
+    from reservoir_tpu.ops import merge_pallas
+    from reservoir_tpu.parallel.merge import host_pairwise_trace_count
+    from reservoir_tpu.serve import ShardedReservoirService
+
+    n_shards = int(os.environ.get("RESERVOIR_BENCH_SHARDS", 4))
+    n_migrations = int(os.environ.get("RESERVOIR_BENCH_MIGRATIONS", 24))
+    impl = os.environ.get("RESERVOIR_BENCH_MERGE_IMPL", "auto")
+    cfg = SamplerConfig(max_sample_size=k, num_reservoirs=S, tile_size=B)
+    # half occupancy like the shards row: hash skew and migration targets
+    # both need free rows on every shard
+    n_sessions = max(n_shards * 2, n_shards * S // 2)
+    keys = [f"s{i}" for i in range(n_sessions)]
+    rng = np.random.default_rng(0)
+    merge_groups = [
+        [keys[int(j)] for j in rng.integers(0, n_sessions, 8)]
+        for _ in range(8)
+    ]
+
+    def _slice_spec(seed):
+        # one short open-loop traffic slice (the loadgen schedule is a
+        # pure function of the spec, so slices are reproducible)
+        return loadgen.LoadSpec(
+            duration_s=10.0,
+            rate=4000.0,
+            arrivals="poisson",
+            sessions=n_sessions,
+            zipf_s=0.3,
+            chunk=B,
+            churn=0.0,
+            snapshot_every=0,
+            max_arrivals=max(8, n_sessions // 4),
+            seed=seed,
+        )
+
+    class _LazyOpen:
+        """loadgen facade: its lazy per-key open must tolerate sessions
+        this stage pre-opened (table.open treats a re-open as ValueError)."""
+
+        def __init__(self, cl):
+            self._cl = cl
+
+        def open_session(self, key):
+            try:
+                return self._cl.open_session(key)
+            except ValueError:
+                return None  # already leased by the bulk feed
+
+        def __getattr__(self, name):
+            return getattr(self._cl, name)
+
+    def one_pass(r, collect=None):
+        cl_dir = tempfile.mkdtemp(prefix="reservoir_merge_bench_")
+        stale = 0
+        migrations = 0
+        try:
+            cluster = ShardedReservoirService(
+                cfg,
+                n_shards,
+                cl_dir,
+                key=r,
+                standby=False,
+                checkpoint_every=1 << 30,
+                coalesce_bytes=1 << 20,
+            )
+            # bulk traffic: open + feed the universe so merges and
+            # migrations act on live, partially-filled reservoirs
+            for key in keys:
+                cluster.open_session(key)
+            for s in range(steps):
+                for i, key in enumerate(keys):
+                    cluster.ingest(
+                        key,
+                        (np.arange(B, dtype=np.int32) + s * B + i),
+                    )
+            cluster.sync()
+            t0 = time.perf_counter()
+            # ---- merge A/B: host tree vs device collective, bit-checked
+            for g, group in enumerate(merge_groups):
+                host = cluster.merged_snapshot(group, merge_key=g)
+                dev = cluster.merged_snapshot(
+                    group, merge_key=g, device=impl
+                )
+                if not np.array_equal(host, np.asarray(dev)):
+                    raise RuntimeError(
+                        f"device merge diverged from host on group {g}"
+                    )
+                if g == 0:
+                    traces0 = host_pairwise_trace_count("uniform")
+            if host_pairwise_trace_count("uniform") != traces0:
+                raise RuntimeError(
+                    "host pairwise merge re-traced on a repeated "
+                    "same-shape merge (memoization regression)"
+                )
+            # ---- migration rehearsal under loadgen traffic slices
+            mig_rng = np.random.default_rng(1000 + r)
+            facade = _LazyOpen(cluster)
+            while migrations < n_migrations:
+                loadgen.run_load(facade, _slice_spec(10_000 * r + migrations))
+                key = keys[int(mig_rng.integers(0, n_sessions))]
+                src_unit, src = cluster._route(key)
+                if key not in src_unit.table:
+                    continue  # evicted under traffic pressure — pick again
+                frees = [
+                    d
+                    for d in range(n_shards)
+                    if d != src
+                    and len(cluster.unit(d).table) < S
+                ]
+                if not frees:
+                    continue
+                dst = int(frees[int(mig_rng.integers(0, len(frees)))])
+                before = cluster.snapshot(key)  # synced read, pre-move
+                cluster.migrate(key, dst)
+                migrations += 1
+                # stale-read probes: the moved row must read back
+                # bit-identically, be owned by dst, and be gone from src
+                after = cluster.snapshot(key, sync=False)
+                if not np.array_equal(before, after):
+                    stale += 1
+                if cluster.shard_of(key) != dst or (
+                    key not in cluster.unit(dst).table
+                ):
+                    stale += 1
+                try:
+                    cluster.unit(src).service.snapshot(key)
+                    stale += 1  # double-serve: src still answered
+                except UnknownSessionError:
+                    pass
+            wall = time.perf_counter() - t0
+            if collect is not None:
+                collect["serve"] = cluster.metrics_snapshot()
+            cluster.shutdown()
+            return wall, stale, migrations
+        finally:
+            shutil.rmtree(cl_dir, ignore_errors=True)
+
+    one_pass(0)  # warm: flush shapes + both merge paths + adopt scatter
+    reg = obs.enable(obs.Registry())
+    try:
+        times, detail = [], {}
+        stale_total = 0
+        migrations_total = 0
+        for r in range(1, reps + 1):
+            wall, stale, migs = one_pass(
+                r, collect=detail if r == reps else None
+            )
+            times.append(wall)
+            stale_total += stale
+            migrations_total += migs
+        if stale_total:
+            raise RuntimeError(
+                f"{stale_total} stale reads across "
+                f"{migrations_total} live migrations"
+            )
+        mh = reg.histogram("cluster.merge_s")
+        md = reg.histogram("cluster.merge_device_s")
+        mig = reg.histogram("cluster.migrate_s")
+        stages = {
+            "shards": n_shards,
+            "per_shard_rows": S,
+            "sessions": n_sessions,
+            "merge_groups": len(merge_groups) * reps,
+            "elements": n_sessions * B * steps,
+            "device_impl": (
+                "pallas" if impl != "xla" and merge_pallas.available()
+                else "xla"
+            ),
+            "host_p50_ms": round(mh.quantile(0.5) * 1e3, 4),
+            "host_p99_ms": round(mh.quantile(0.99) * 1e3, 4),
+            "device_p50_ms": round(md.quantile(0.5) * 1e3, 4),
+            "device_p99_ms": round(md.quantile(0.99) * 1e3, 4),
+            "merge_speedup_p50": round(
+                mh.quantile(0.5) / max(md.quantile(0.5), 1e-9), 3
+            ),
+            "bit_identical": True,
+            "retrace_free": True,
+            "migrations": migrations_total,
+            "stale_reads": stale_total,
+            "migration_p50_ms": round(mig.quantile(0.5) * 1e3, 4),
+            "migration_p99_ms": round(mig.quantile(0.99) * 1e3, 4),
+            "serve": detail.get("serve", {}),
+            "telemetry": _telemetry_summary(
+                reg,
+                ("cluster.merge_s", "cluster.merge_device_s",
+                 "cluster.migrate_s", "bridge.flush_s",
+                 "bridge.journal_append_s"),
+            ),
+        }
+    finally:
+        obs.disable()
+    return times, stages
+
+
 def _bench_transfer(S, k, B, steps, reps):
     """RAW host->device transfer bandwidth at the bridge's tile shape — the
     wire ceiling the bridge number is judged against (VERDICT r2 item 3:
@@ -1187,11 +1412,12 @@ def main() -> None:
     if config not in (
         "algl", "distinct", "weighted", "bridge", "stream", "host",
         "transfer", "serve", "ha", "traffic", "gated", "shards", "trace",
+        "merge",
     ):
         raise SystemExit(
             "RESERVOIR_BENCH_CONFIG must be algl|distinct|weighted|bridge|"
-            "stream|host|transfer|serve|ha|traffic|gated|shards|trace, "
-            f"got {config!r}"
+            "stream|host|transfer|serve|ha|traffic|gated|shards|trace|"
+            f"merge, got {config!r}"
         )
     if impl not in ("auto", "xla", "pallas"):
         raise SystemExit(
@@ -1228,6 +1454,13 @@ def main() -> None:
             # merged-snapshot latency (ISSUE 9)
             "shards": (24 if smoke else 512, 8 if smoke else 32,
                        16 if smoke else 256),
+            # merge: the device-vs-host merge A/B + live-migration
+            # rehearsal (ISSUE 12); R is the PER-SHARD row capacity like
+            # shards — the row is judged on merge p50/p99 (both paths,
+            # bit-identity asserted in-run) + migration latency with zero
+            # stale reads
+            "merge": (24 if smoke else 512, 8 if smoke else 32,
+                      16 if smoke else 256),
             # traffic: R is the TABLE capacity; the loadgen universe
             # overcommits it (>= 10k simulated sessions non-smoke) and
             # the row is judged on corrected wait + SLO verdicts
@@ -1253,6 +1486,7 @@ def main() -> None:
             "serve": 2 if smoke else 4,
             "ha": 2 if smoke else 4,
             "shards": 2 if smoke else 4,
+            "merge": 2 if smoke else 4,
             # traffic: steps scales arrivals (steps * universe)
             "traffic": 2,
             "gated": 4 if smoke else 40,
@@ -1461,6 +1695,9 @@ def main() -> None:
         elif config == "shards":
             times, shards_stages = _bench_shards(R, k, B, steps, reps)
             tag = "shards_cluster_feed"
+        elif config == "merge":
+            times, merge_stages = _bench_merge(R, k, B, steps, reps)
+            tag = "merge_device_feed"
         elif config == "traffic":
             times, traffic_stages = _bench_traffic(R, k, B, steps, reps)
             tag = "traffic_loadgen"
@@ -1482,6 +1719,10 @@ def main() -> None:
         # arrivals are drawn from the declared process, not R*B*steps —
         # the honest element count is what the loadgen actually ingested
         n_elems = traffic_stages["elements"]
+    if config == "merge":
+        # sessions are hash-routed at half occupancy like shards; the
+        # honest element count is the deterministic bulk feed
+        n_elems = merge_stages["elements"]
     value = n_elems / min(times)
     median = n_elems / sorted(times)[len(times) // 2]
     record = {
@@ -1516,6 +1757,17 @@ def main() -> None:
         record["shards"] = shards_stages["shards"]
         record["failover_ms"] = shards_stages["failover_ms_best"]
         record["merge_p99_ms"] = shards_stages["merge_p99_ms"]
+    if config == "merge":
+        # the merge row's real currency: device-vs-host merge latency
+        # (bit-identity asserted in-run) + live-migration latency with
+        # zero stale reads (ISSUE 12 acceptance surface)
+        record["stages"] = merge_stages
+        record["device_impl"] = merge_stages["device_impl"]
+        record["host_p99_ms"] = merge_stages["host_p99_ms"]
+        record["device_p99_ms"] = merge_stages["device_p99_ms"]
+        record["migration_p99_ms"] = merge_stages["migration_p99_ms"]
+        record["migrations"] = merge_stages["migrations"]
+        record["stale_reads"] = merge_stages["stale_reads"]
     if config == "gated":
         # the gated row's real currency: effective elem/s vs the ungated
         # A/B, plus the skip fraction that earned it (ISSUE 8 acceptance:

@@ -64,6 +64,18 @@ _SHARED_UPDATE_JIT: dict = {}
 _SHARED_RESET_JIT: dict = {}
 
 
+def _adopt_scatter(state, part, idx):
+    """Scatter a whole exported per-row sub-state over ``idx`` — the
+    migration adopt (tree structure and shapes are jit's own cache axes,
+    so one process-wide wrapper serves every engine)."""
+    return jax.tree.map(
+        lambda full, one: full.at[idx].set(one), state, part
+    )
+
+
+_ADOPT_JIT = jax.jit(_adopt_scatter, donate_argnums=(0,))
+
+
 class ReservoirEngine:
     """R independent k-reservoirs updated in lockstep on device.
 
@@ -105,6 +117,7 @@ class ReservoirEngine:
         reusable: bool = False,
         mesh: Optional[jax.sharding.Mesh] = None,
         *,
+        device: Optional[Any] = None,
         faults: Optional[Any] = None,
         _initial_state: Any = None,
     ) -> None:
@@ -196,6 +209,18 @@ class ReservoirEngine:
             )
         elif mesh is not None:
             raise ValueError("mesh requires config.mesh_axis to be set")
+        # Per-shard device placement (ISSUE 12, ROADMAP item-1 remainder):
+        # pin this engine's whole state to one device so N shard engines
+        # spread over the chips of a slice instead of stacking on the
+        # default device.  Every host input is device_put onto the pin, so
+        # updates never see mixed placements.  Orthogonal to mesh sharding
+        # (one engine over many chips) — mutually exclusive by contract.
+        self._device = device
+        if device is not None and self._mesh is not None:
+            raise ValueError(
+                "device pinning and mesh sharding are mutually exclusive "
+                "(a pinned engine lives on one chip)"
+            )
         if _initial_state is not None:
             # checkpoint-restore path (utils.checkpoint.load_engine): adopt
             # the restored pytree instead of paying ops.init for buffers
@@ -223,6 +248,8 @@ class ReservoirEngine:
             self._state = shard_state(
                 self._state, self._mesh, config.mesh_axis
             )
+        if self._device is not None:
+            self._state = jax.device_put(self._state, self._device)
         # Host-side lower bound on every reservoir's count — exact when all
         # tiles are full-width, conservative under ragged `valid`.  Decides
         # fill vs steady dispatch with no device readback.
@@ -262,6 +289,25 @@ class ReservoirEngine:
         """True iff any update compiled so far took the XLA path (fill and
         ragged tiles always do in duplicates mode)."""
         return any(not self._key_uses_pallas(k) for k in self._jit_cache)
+
+    @property
+    def device(self) -> Optional[Any]:
+        """The device this engine is pinned to (``None`` = default
+        placement or mesh-sharded)."""
+        return self._device
+
+    def _pin_device(self, device: Optional[Any]) -> None:
+        """Pin a restored engine's state to ``device`` (the checkpoint
+        recover path: ``load_engine`` adopts the state first, the owning
+        bridge/service then pins it where the shard lives)."""
+        if device is None:
+            return
+        if self._mesh is not None:
+            raise ValueError(
+                "device pinning and mesh sharding are mutually exclusive"
+            )
+        self._device = device
+        self._state = jax.device_put(self._state, device)
 
     @property
     def is_open(self) -> bool:
@@ -708,7 +754,7 @@ class ReservoirEngine:
                     stage, {key: shards[key] for key in stage}
                 )
             else:
-                placed = jax.device_put(stage)
+                placed = jax.device_put(stage, self._device)
         else:
             placed = {}
         if tile_host is not None:
@@ -821,7 +867,8 @@ class ReservoirEngine:
                     _SHARED_UPDATE_JIT[shared_key] = fn
             self._jit_cache[cache_key] = fn
         placed = jax.device_put(
-            {"tile": tile_host, "nvalid": nvalid_np, "advance": advance_np}
+            {"tile": tile_host, "nvalid": nvalid_np, "advance": advance_np},
+            self._device,
         )
         self._state = fn(
             self._state, placed["tile"], placed["nvalid"], placed["advance"]
@@ -1023,7 +1070,7 @@ class ReservoirEngine:
             )
             placed = jax.device_put(stage, jax.tree.map(lambda _: sh, stage))
         else:
-            placed = jax.device_put(stage)
+            placed = jax.device_put(stage, self._device)
         def rebuild_xla():
             return self._fused_update_fn(
                 n_full, B, steady, stream.dtype, False
@@ -1061,13 +1108,7 @@ class ReservoirEngine:
         path (a device-side no-op for rows already full).
         """
         self._check_open()
-        rows = np.asarray(rows, np.int32)
-        if rows.ndim != 1 or rows.size == 0:
-            raise ValueError(f"rows must be a non-empty 1-D index array, got shape {rows.shape}")
-        R = self._config.num_reservoirs
-        if int(rows.min()) < 0 or int(rows.max()) >= R:
-            bad = int(rows[np.argmax((rows < 0) | (rows >= R))])
-            raise ValueError(f"row {bad} out of range [0, {R})")
+        rows = self._validate_rows(rows)
         if isinstance(key, int):
             key = jr.key(key)
         fn = self._reset_jit.get(rows.size)
@@ -1115,6 +1156,72 @@ class ReservoirEngine:
 
             # the scatter may have loosened the reservoir-axis sharding;
             # re-pin it so later updates stay collective-free SPMD
+            self._state = shard_state(
+                self._state, self._mesh, self._config.mesh_axis
+            )
+        self._min_count = 0
+        self.reset_epochs += 1
+
+    def _validate_rows(self, rows: Any) -> np.ndarray:
+        rows = np.asarray(rows, np.int32)
+        if rows.ndim != 1 or rows.size == 0:
+            raise ValueError(
+                f"rows must be a non-empty 1-D index array, got shape {rows.shape}"
+            )
+        R = self._config.num_reservoirs
+        if int(rows.min()) < 0 or int(rows.max()) >= R:
+            bad = int(rows[np.argmax((rows < 0) | (rows >= R))])
+            raise ValueError(f"row {bad} out of range [0, {R})")
+        return rows
+
+    def export_rows(self, rows: Any):
+        """Gather the COMPLETE per-row sub-state for ``rows`` — samples,
+        counters, and the per-row PRNG keys — as a pytree with leading
+        axis ``len(rows)``: the live-migration export (ISSUE 12).
+
+        Every state field carries the reservoir axis first (the same
+        invariant :meth:`reset_rows` scatters against), so the export is a
+        uniform gather and :meth:`adopt_rows` on another engine of the
+        SAME config/mode reproduces the rows bit-exactly — including
+        future acceptance draws, because per-row keys travel with the
+        rows.  The gathered arrays are fresh buffers, safe against the
+        donation fast path.  Single-writer contract as :meth:`sample`:
+        drain a pipelined bridge first.
+        """
+        self._check_open()
+        rows = self._validate_rows(rows)
+        idx = jnp.asarray(rows)
+        return jax.tree.map(lambda x: x[idx], self._state)
+
+    def adopt_rows(self, rows: Any, sub_state: Any) -> None:
+        """Scatter an :meth:`export_rows` sub-state over ``rows`` — the
+        live-migration adopt.  One jitted dispatch (shared process-wide;
+        the dual of :meth:`reset_rows`'s init-scatter).  The adopted rows
+        continue their source streams bit-identically; like a reset, the
+        adopt drops the host-side fill lower bound and bumps
+        :attr:`reset_epochs` so an ingest-side skip gate re-pulls.
+        """
+        self._check_open()
+        rows = self._validate_rows(rows)
+        lead = {int(x.shape[0]) for x in jax.tree.leaves(sub_state)}
+        if lead != {int(rows.size)}:
+            raise ValueError(
+                f"sub_state leading axis {sorted(lead)} does not match "
+                f"{rows.size} rows"
+            )
+        if self._device is not None:
+            # the exported rows may be committed to the SOURCE shard's
+            # device — re-commit before the scatter (mixed committed
+            # placements are an error under jit)
+            sub_state = jax.device_put(sub_state, self._device)
+        idx: Any = rows
+        if self._mesh is not None:
+            idx = jax.device_put(rows)  # scatter indices are replicated
+            sub_state = jax.device_put(sub_state)
+        self._state = _ADOPT_JIT(self._state, sub_state, idx)
+        if self._mesh is not None:
+            from .parallel import shard_state
+
             self._state = shard_state(
                 self._state, self._mesh, self._config.mesh_axis
             )

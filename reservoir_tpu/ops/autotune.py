@@ -1,4 +1,4 @@
-"""Persistent block-geometry autotuner for ALL three Pallas kernels.
+"""Persistent block-geometry autotuner for the Pallas kernels + skip gate.
 
 Every kernel's throughput is set by shape knobs — ``block_r`` (reservoir
 rows per grid cell), ``chunk_b`` (batch-streaming chunk of the 2-D grid
@@ -56,8 +56,11 @@ _REPO = os.path.dirname(
 _DEFAULT_CACHE = os.path.join(_REPO, "TPU_ALGL_AUTOTUNE.json")
 
 _SCHEMA = 2
-#: The kernel dimension of the cache key — one entry space per Pallas path.
-KERNELS = ("algl", "weighted", "distinct")
+#: The kernel dimension of the cache key — one entry space per Pallas path,
+#: plus the host-side ``gate`` pseudo-kernel (the skip-ahead gate's
+#: ``gate_tile``/``gate_push_chunk`` pair is a throughput geometry too, and
+#: the sweep measures it the same way).
+KERNELS = ("algl", "weighted", "distinct", "gate")
 
 # (path, mtime) -> parsed dict; loads are hot (one per engine jit-cache
 # miss), files are tiny and almost never change mid-process
@@ -71,11 +74,17 @@ class Geometry(NamedTuple):
     ``chunk_b``: batch-streaming chunk (0 = whole tile, no 2-D grid).
     ``gather_chunk``: one-hot gather window (0 = full width; algl only —
     the weighted/distinct kernels ignore it).
+    ``gate_tile`` / ``gate_push_chunk``: candidate-tile width and push
+    slice width of the skip-ahead gate (``kernel="gate"`` entries only;
+    0 = untuned, callers keep their defaults).  Schema-additive trailing
+    fields — entries written before they existed read back as 0.
     """
 
     block_r: int
     chunk_b: int
     gather_chunk: int
+    gate_tile: int = 0
+    gate_push_chunk: int = 0
 
 
 def cache_path() -> str:
@@ -157,6 +166,8 @@ def lookup(
             block_r=int(entry["block_r"]),
             chunk_b=int(entry.get("chunk_b", 0)),
             gather_chunk=int(entry.get("gather_chunk", 0)),
+            gate_tile=int(entry.get("gate_tile", 0)),
+            gate_push_chunk=int(entry.get("gate_push_chunk", 0)),
         )
     except (KeyError, TypeError, ValueError):
         return None
@@ -186,6 +197,11 @@ def record(
         "chunk_b": int(geometry.chunk_b),
         "gather_chunk": int(geometry.gather_chunk),
     }
+    # gate fields only when set — non-gate entries keep their exact shape
+    if geometry.gate_tile:
+        entry["gate_tile"] = int(geometry.gate_tile)
+    if geometry.gate_push_chunk:
+        entry["gate_push_chunk"] = int(geometry.gate_push_chunk)
     if elem_per_sec is not None:
         entry["elem_per_sec"] = float(elem_per_sec)
     if source is not None:

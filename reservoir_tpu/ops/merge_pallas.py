@@ -1,0 +1,166 @@
+"""Pallas ring all-gather for the device-side reservoir merge (ISSUE 12).
+
+The collective half of :func:`reservoir_tpu.parallel.merge.merge_samples_device`:
+per-part reservoir state (sample rows, counts, and the per-mode sub-state
+leaves) moves between devices as chip-to-chip ``make_async_remote_copy``
+remote DMAs around a logical ring — the SNIPPETS [1]/[3] pattern — instead
+of an XLA ``all_gather``.  The kernel is DATA MOVEMENT only: it fills the
+``[d, b, W]`` gathered buffer and the deterministic node-numbered merge
+tree then runs on-chip in the enclosing ``shard_map`` program, so the
+merged result is bit-identical to the XLA-collective and host paths by
+construction (same pairwise math, same tree order — the kernel never
+touches a sample value).
+
+Ring protocol (one step per remote block):
+
+- every device stores its local block into its own slot of the output
+  buffer, then barriers with both ring neighbors
+  (``get_barrier_semaphore``, the collective-id handshake);
+- at step ``s`` each device forwards the block it holds for logical part
+  slot ``(my - s) mod d`` to its right neighbor's same slot and waits for
+  the matching block ``(my - 1 - s) mod d`` arriving from the left.  Each
+  output slot is written exactly once, and a slot is only forwarded one
+  step after its arrival was waited on, so the fully-waited ring needs no
+  double buffer.
+
+TPU-only (remote DMA does not lower on the CPU interpreter): callers gate
+on :func:`available` and demote to XLA collectives — parity on real
+hardware rides the ``parity_probe`` selftest JSON (``merge_parity`` row).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+__all__ = ["available", "ring_all_gather", "gather_parts"]
+
+# Lane/sublane-friendly pack geometry: the packed part matrix is padded to
+# [b multiple of 8, W multiple of 128] uint32 words before it rides the ring.
+_LANES = 128
+_SUBLANES = 8
+
+
+def available() -> bool:
+    """Whether the ring kernel can lower here (TPU backend only — remote
+    DMA has no CPU-interpreter path)."""
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:
+        return False
+
+
+def _ring_kernel(local_ref, out_ref, send_sem, recv_sem, *, axis, d):
+    my_id = jax.lax.axis_index(axis)
+    right = jax.lax.rem(my_id + 1, d)
+    left = jax.lax.rem(my_id + d - 1, d)
+    # local block lands in its own output slot before anything moves
+    out_ref[pl.ds(my_id, 1)] = local_ref[:][None]
+    # neighbor handshake: no remote DMA may land before both neighbors
+    # have entered the kernel (their output buffers exist)
+    barrier = pltpu.get_barrier_semaphore()
+    pltpu.semaphore_signal(
+        barrier, inc=1, device_id=(left,),
+        device_id_type=pltpu.DeviceIdType.LOGICAL,
+    )
+    pltpu.semaphore_signal(
+        barrier, inc=1, device_id=(right,),
+        device_id_type=pltpu.DeviceIdType.LOGICAL,
+    )
+    pltpu.semaphore_wait(barrier, 2)
+    for step in range(d - 1):
+        # forward the newest fully-arrived block; its slot index is the
+        # same on both ends of the hop, so src and dst refs agree
+        blk = jax.lax.rem(my_id + d - step, d) if step else my_id
+        rdma = pltpu.make_async_remote_copy(
+            src_ref=out_ref.at[pl.ds(blk, 1)],
+            dst_ref=out_ref.at[pl.ds(blk, 1)],
+            send_sem=send_sem,
+            recv_sem=recv_sem,
+            device_id=(right,),
+            device_id_type=pltpu.DeviceIdType.LOGICAL,
+        )
+        rdma.start()
+        # .wait() = my send drained AND the matching block from the left
+        # (slot (my - 1 - step) mod d) has landed — the block forwarded
+        # next step
+        rdma.wait()
+
+
+@functools.lru_cache(maxsize=None)
+def _ring_call(d: int, b: int, w: int, axis: str):
+    """The pallas_call for a ``[b, w]`` uint32 block on a ``d``-ring."""
+    return pl.pallas_call(
+        functools.partial(_ring_kernel, axis=axis, d=d),
+        out_shape=jax.ShapeDtypeStruct((d, b, w), jnp.uint32),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        scratch_shapes=[
+            pltpu.SemaphoreType.DMA(()),
+            pltpu.SemaphoreType.DMA(()),
+        ],
+        compiler_params=pltpu.TPUCompilerParams(
+            has_side_effects=True, collective_id=0
+        ),
+    )
+
+
+def ring_all_gather(block: jax.Array, *, axis: str, axis_size: int) -> jax.Array:
+    """All-gather one ``[b, w]`` uint32 block over the ``axis`` ring via
+    remote DMA: returns ``[axis_size, b, w]`` with slot ``i`` holding
+    device ``i``'s block.  Must run inside ``shard_map`` over ``axis``."""
+    b, w = block.shape
+    return _ring_call(axis_size, b, w, axis)(block)
+
+
+def gather_parts(
+    leaves: Sequence[jax.Array], *, axis: str, axis_size: int
+) -> Tuple[jax.Array, ...]:
+    """All-gather every per-part state leaf over the ``axis`` ring in ONE
+    packed remote-DMA stream.
+
+    Each leaf is ``[b, ...]`` (this device's block of part rows, all
+    4-byte dtypes).  Leaves are flattened per row, bitcast to uint32,
+    concatenated into one ``[b, W]`` matrix (padded to lane/sublane
+    multiples), sent around the ring once, then split and bitcast back —
+    so a merge's sample tile, counts, and per-mode sub-state cross the
+    interconnect as a single DMA per hop.  Returns the gathered leaves
+    with leading axis ``axis_size * b`` (device-major part order, matching
+    the XLA ``all_gather`` + reshape layout).
+    """
+    b = leaves[0].shape[0]
+    cols = []
+    widths = []
+    for leaf in leaves:
+        if np.dtype(leaf.dtype).itemsize != 4:
+            raise ValueError(
+                f"gather_parts packs 4-byte leaves only, got {leaf.dtype}"
+            )
+        flat = leaf.reshape(b, -1)
+        if flat.dtype != jnp.uint32:
+            flat = jax.lax.bitcast_convert_type(flat, jnp.uint32)
+        cols.append(flat)
+        widths.append(flat.shape[1])
+    packed = jnp.concatenate(cols, axis=1) if len(cols) > 1 else cols[0]
+    w_tot = packed.shape[1]
+    w_pad = -(-w_tot // _LANES) * _LANES
+    b_pad = -(-b // _SUBLANES) * _SUBLANES
+    if w_pad != w_tot or b_pad != b:
+        packed = jnp.pad(packed, ((0, b_pad - b), (0, w_pad - w_tot)))
+    gathered = ring_all_gather(packed, axis=axis, axis_size=axis_size)
+    flat_g = gathered[:, :b].reshape(axis_size * b, w_pad)
+    out = []
+    off = 0
+    for leaf, width in zip(leaves, widths):
+        part = flat_g[:, off : off + width]
+        off += width
+        if leaf.dtype != jnp.uint32:
+            part = jax.lax.bitcast_convert_type(part, leaf.dtype)
+        out.append(part.reshape((axis_size * b,) + leaf.shape[1:]))
+    return tuple(out)

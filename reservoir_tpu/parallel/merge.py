@@ -37,14 +37,67 @@ from ..ops import distinct as _distinct
 from ..ops import weighted as _weighted
 from ..utils.tracing import trace_span
 
+from ..utils.log import warn_once
+
 __all__ = [
     "uniform_stream_merger",
     "distinct_stream_merger",
     "weighted_stream_merger",
     "merge_samples_host",
+    "merge_samples_device",
+    "host_pairwise_trace_count",
 ]
 
-_HOST_PAIRWISE = None  # lazily jitted merge_samples (host tree merges)
+_MODES = ("uniform", "weighted", "distinct")
+
+
+class _Flags:
+    """Module-scoped once-flags for the device-merge demotion logs."""
+
+
+_flags = _Flags()
+
+
+def _pairwise_fn(mode: str):
+    """The eager pairwise merge over per-part leaf tuples for ``mode``
+    (uniform takes a key and is handled separately — its tree is
+    node-numbered)."""
+    if mode == "weighted":
+
+        def pw(a, b):
+            return _weighted.merge_parts(a[0], a[1], a[2], b[0], b[1], b[2])
+
+        return pw
+
+    def pw(a, b):  # distinct: leaves (values, hash_hi, hash_lo, size,
+        # count, salts) — salts shared (same init key), A's carried
+        sa = _distinct.DistinctState(a[0], a[1], a[2], a[3], a[4], a[5])
+        sb = _distinct.DistinctState(b[0], b[1], b[2], b[3], b[4], b[5])
+        m = _distinct.merge(sa, sb)
+        return (m.values, m.hash_hi, m.hash_lo, m.size, m.count, a[5])
+
+    return pw
+
+
+@functools.lru_cache(maxsize=None)
+def _host_pairwise(mode: str = "uniform"):
+    """One process-wide jitted pairwise merge per mode (shapes/dtypes are
+    jit's own cache axes).  Hoisted out of :func:`merge_samples_host`'s
+    module global into the same memoization discipline as the stream-merger
+    constructors: repeated cluster ``merged_snapshot`` calls reuse one
+    wrapper, so the second merge at any shape is trace-free (asserted by
+    ``bench.py merge``)."""
+    if mode == "uniform":
+        return jax.jit(_algl.merge_samples)
+    pw = _pairwise_fn(mode)
+    return jax.jit(lambda a, b: pw(a, b))
+
+
+def host_pairwise_trace_count(mode: str = "uniform") -> int:
+    """Number of distinct pairwise-merge traces compiled so far for
+    ``mode`` — stable across repeated same-shape merges (the satellite
+    trace-free assertion ``bench.py merge`` pins in-run)."""
+    return _host_pairwise(mode)._cache_size()
 
 
 def merge_samples_host(
@@ -87,11 +140,9 @@ def merge_samples_host(
     if isinstance(key, int):
         key = jr.key(key)
     dtype = np.asarray(parts[0][0]).dtype
-    global _HOST_PAIRWISE
-    if _HOST_PAIRWISE is None:
-        # one jitted pairwise merge, shape/dtype-cached by jit itself:
-        # the eager k-step scan costs ~100x per pair on the host path
-        _HOST_PAIRWISE = jax.jit(_algl.merge_samples)
+    # one jitted pairwise merge, shape/dtype-cached by jit itself: the
+    # eager k-step scan costs ~100x per pair on the host path
+    pairwise = _host_pairwise("uniform")
 
     def _lift(sample, count):
         arr = np.zeros((1, k), dtype)
@@ -106,7 +157,7 @@ def merge_samples_host(
             nxt = []
             for i in range(0, len(items) - 1, 2):
                 node += 1
-                s, c = _HOST_PAIRWISE(
+                s, c = pairwise(
                     items[i][0], items[i][1],
                     items[i + 1][0], items[i + 1][1],
                     jr.fold_in(key, node),
@@ -118,6 +169,316 @@ def merge_samples_host(
         samples, count = items[0]
     total = int(np.asarray(count)[0])
     return np.asarray(samples)[0, : min(total, k)], total
+
+
+_MERGE_AXIS = "part"
+
+
+@functools.lru_cache(maxsize=None)
+def _device_tree_merger(
+    n_parts: int, d: int, mode: str, n_leaves: int, use_pallas: bool
+):
+    """Jitted collective tree merge over ``n_parts`` stacked part rows on a
+    ``d``-device 1-D mesh.
+
+    Inputs are the stacked per-part leaves ``[Ppad, ...]`` (``Ppad`` a
+    multiple of ``d``; rows past ``n_parts`` are layout padding), sharded
+    ``P(part)`` on the leading axis — each device holds a contiguous block
+    of parts.  Inside ``shard_map`` the blocks are exchanged — a Pallas
+    ``make_async_remote_copy`` ring (:mod:`reservoir_tpu.ops.merge_pallas`)
+    or an XLA ``all_gather`` — and every device then runs the SAME
+    deterministic node-numbered log-depth tree over the first ``n_parts``
+    parts (a static Python loop, unrolled at trace time), so the output is
+    replicated by construction and bit-identical to
+    :func:`merge_samples_host` (same pairwise math, same tree order).
+    Memoized per ``(n_parts, d, mode, impl)``; shapes/dtypes are jit's own
+    cache axes.
+    """
+    mesh = Mesh(np.asarray(jax.devices()[:d]), (_MERGE_AXIS,))
+
+    def local(*args):
+        if mode == "uniform":
+            leaves, key = args[:-1], args[-1]
+        else:
+            leaves = args
+        if use_pallas:
+            from ..ops import merge_pallas as _mp
+
+            gathered = _mp.gather_parts(
+                leaves, axis=_MERGE_AXIS, axis_size=d
+            )
+        else:
+            gathered = [
+                jnp.reshape(
+                    jax.lax.all_gather(leaf, _MERGE_AXIS),
+                    (-1,) + leaf.shape[1:],
+                )
+                for leaf in leaves
+            ]
+        items = [
+            tuple(g[p][None] for g in gathered) for p in range(n_parts)
+        ]
+        if mode == "uniform":
+            node = 0
+            while len(items) > 1:
+                nxt = []
+                for i in range(0, len(items) - 1, 2):
+                    node += 1
+                    s, c = _algl.merge_samples(
+                        items[i][0], items[i][1],
+                        items[i + 1][0], items[i + 1][1],
+                        jr.fold_in(key, node),
+                    )
+                    nxt.append((s, c))
+                if len(items) % 2:
+                    nxt.append(items[-1])
+                items = nxt
+        else:
+            pairwise = _pairwise_fn(mode)
+            while len(items) > 1:
+                nxt = [
+                    pairwise(items[i], items[i + 1])
+                    for i in range(0, len(items) - 1, 2)
+                ]
+                if len(items) % 2:
+                    nxt.append(items[-1])
+                items = nxt
+        return items[0]
+
+    in_specs = (P(_MERGE_AXIS),) * n_leaves
+    if mode == "uniform":
+        in_specs = in_specs + (P(),)  # the merge key is replicated
+    return jax.jit(
+        _shard_map(
+            local,
+            mesh=mesh,
+            in_specs=in_specs,
+            out_specs=(P(),) * n_leaves,
+            check_vma=False,
+        )
+    )
+
+
+def _resolve_merge_impl(impl: str, n_parts: int, four_byte: bool) -> str:
+    """auto|pallas|xla|host -> the path actually taken, with graceful
+    demotion (Pallas needs a TPU backend, >= 2 devices, and 4-byte leaves;
+    any collective needs a live backend)."""
+    if impl not in ("auto", "pallas", "xla", "host"):
+        raise ValueError(
+            f"impl must be one of 'auto'|'pallas'|'xla'|'host', got {impl!r}"
+        )
+    if impl == "host" or n_parts == 1:
+        return "host"
+    try:
+        n_dev = len(jax.devices())
+        backend = jax.default_backend()
+    except Exception as e:  # backend init failure: the host path needs none
+        warn_once(
+            _flags, "_backend_down_logged",
+            "merge_samples_device: device backend unreachable (%s); "
+            "demoting to the host merge path (logged once)", e,
+            logger=__name__,
+        )
+        return "host"
+    d = min(n_dev, n_parts)
+    pallas_ok = backend == "tpu" and d >= 2 and four_byte
+    if impl == "auto":
+        if pallas_ok:
+            return "pallas"
+        return "xla" if d >= 2 else "host"
+    if impl == "pallas" and not pallas_ok:
+        warn_once(
+            _flags, "_pallas_demoted_logged",
+            "merge_samples_device: impl='pallas' unavailable (backend=%s, "
+            "devices=%d, 4-byte leaves=%s); demoting to the XLA-collective "
+            "path (logged once)", backend, d, four_byte,
+            logger=__name__,
+        )
+        return "xla" if d >= 2 else "host"
+    return impl
+
+
+def _merge_leaf_dtypes_4byte(leaves) -> bool:
+    return all(np.dtype(leaf.dtype).itemsize == 4 for leaf in leaves)
+
+
+def merge_samples_device(
+    parts,
+    key=None,
+    *,
+    max_sample_size: int,
+    mode: str = "uniform",
+    impl: str = "auto",
+):
+    """Device-side collective counterpart of :func:`merge_samples_host`:
+    the same deterministic node-numbered log-depth merge tree, but part
+    state moves between devices over the interconnect — a Pallas
+    ``make_async_remote_copy`` ring permute
+    (:mod:`reservoir_tpu.ops.merge_pallas`) on TPU, an XLA ``all_gather``
+    collective otherwise — and every pairwise merge runs on-chip.
+    Bit-reconcilable with the host path by construction: identical lifted
+    inputs, identical pairwise math (:func:`~reservoir_tpu.ops.algorithm_l.merge_samples`
+    / :func:`~reservoir_tpu.ops.weighted.merge_parts` /
+    :func:`~reservoir_tpu.ops.distinct.merge`), identical
+    ``fold_in(key, node)`` tree numbering (pinned by
+    ``tests/test_merge_device.py``).
+
+    Args:
+      parts: per-mode part tuples —
+
+        - ``mode="uniform"``: ``(sample, count)`` pairs exactly as
+          :func:`merge_samples_host` takes (1-D samples already truncated
+          to their fill, total stream counts);
+        - ``mode="weighted"``: ``(samples [k], lkeys [k], count)`` rows of
+          a :class:`~reservoir_tpu.ops.weighted.WeightedState` (full
+          ``k``-wide slot rows, empty slots at ``-inf`` lkeys);
+        - ``mode="distinct"``: ``(values [k], hash_hi [k], hash_lo [k],
+          size, count, salts [4])`` rows of a narrow
+          :class:`~reservoir_tpu.ops.distinct.DistinctState`; all parts
+          must share salts (shards of one logical stream).
+      key: PRNG key or int seed for the uniform merge draws (ignored by
+        the state-keyed weighted/distinct merges).
+      max_sample_size: the configs' ``k``.
+      mode: ``"uniform"`` | ``"weighted"`` | ``"distinct"``.
+      impl: ``"auto"`` (Pallas on TPU, else XLA collectives, else host),
+        ``"pallas"``/``"xla"`` to force a path (Pallas demotes gracefully
+        when unavailable), ``"host"`` for the host tree.
+
+    Returns per mode: uniform ``(merged_sample, total)`` exactly like the
+    host path; weighted ``(samples [k], lkeys [k], total)``; distinct
+    ``(values [k], hash_hi [k], hash_lo [k], size, total)`` — all host
+    ``np.ndarray``/int.
+    """
+    if mode not in _MODES:
+        raise ValueError(f"mode must be one of {_MODES}, got {mode!r}")
+    parts = list(parts)
+    if not parts:
+        raise ValueError("merge_samples_device needs at least one part")
+    k = int(max_sample_size)
+    if mode == "uniform":
+        if isinstance(key, int):
+            key = jr.key(key)
+        elif key is None:
+            raise ValueError("uniform mode requires a merge key")
+        dtype = np.asarray(parts[0][0]).dtype
+        rows = np.zeros((len(parts), k), dtype)
+        counts = np.zeros((len(parts),), np.uint32)
+        for p, (sample, count) in enumerate(parts):
+            s = np.atleast_1d(np.asarray(sample, dtype))[:k]
+            rows[p, : s.shape[0]] = s
+            counts[p] = int(count)
+        leaves = (rows, counts)
+    elif mode == "weighted":
+        leaves = _stack_state_rows(parts, k, 3, mode)
+    else:
+        leaves = _stack_state_rows(parts, k, 6, mode)
+    impl_taken = _resolve_merge_impl(
+        impl, len(parts), _merge_leaf_dtypes_4byte(leaves)
+    )
+    if impl_taken == "host":
+        return _merge_tree_host(parts, leaves, key, k, mode)
+    d = min(len(jax.devices()), len(parts))
+    with trace_span(f"reservoir_merge_device_{impl_taken}"):
+        out = _run_device_merge(leaves, key, mode, impl_taken, d)
+    if mode == "uniform":
+        s, c = out
+        total = int(np.asarray(c)[0])
+        return np.asarray(s)[0, : min(total, k)], total
+    if mode == "weighted":
+        s, lk, c = out
+        return np.asarray(s)[0], np.asarray(lk)[0], int(np.asarray(c)[0])
+    v, hi, lo, size, c, _salts = out
+    return (
+        np.asarray(v)[0],
+        np.asarray(hi)[0],
+        np.asarray(lo)[0],
+        int(np.asarray(size)[0]),
+        int(np.asarray(c)[0]),
+    )
+
+
+def _stack_state_rows(parts, k: int, n_leaves: int, mode: str):
+    """Stack per-part state-row tuples into ``[P, ...]`` leaf arrays."""
+    cols = [[] for _ in range(n_leaves)]
+    for p, part in enumerate(parts):
+        if len(part) != n_leaves:
+            raise ValueError(
+                f"{mode} parts take {n_leaves}-tuples, got "
+                f"{len(part)} fields in part {p}"
+            )
+        for i, field in enumerate(part):
+            arr = np.asarray(field)
+            if arr.ndim == 1 and arr.shape[0] not in (k, 4):
+                raise ValueError(
+                    f"part {p} field {i} must be [{k}]-wide state rows, "
+                    f"got shape {arr.shape}"
+                )
+            cols[i].append(arr)
+    return tuple(np.stack(col) for col in cols)
+
+
+def _merge_tree_host(parts, leaves, key, k: int, mode: str):
+    """Host demotion target: the same tree over the same lifted rows, one
+    jitted pairwise dispatch per node (:func:`_host_pairwise`)."""
+    if mode == "uniform":
+        return merge_samples_host(parts, key, max_sample_size=k)
+    pairwise = _host_pairwise(mode)
+    with trace_span("reservoir_merge_host"):
+        items = [
+            tuple(jnp.asarray(leaf[p][None]) for leaf in leaves)
+            for p in range(len(parts))
+        ]
+        while len(items) > 1:
+            nxt = [
+                tuple(pairwise(items[i], items[i + 1]))
+                for i in range(0, len(items) - 1, 2)
+            ]
+            if len(items) % 2:
+                nxt.append(items[-1])
+            items = nxt
+    out = items[0]
+    if mode == "weighted":
+        s, lk, c = out
+        return np.asarray(s)[0], np.asarray(lk)[0], int(np.asarray(c)[0])
+    v, hi, lo, size, c, _salts = out
+    return (
+        np.asarray(v)[0],
+        np.asarray(hi)[0],
+        np.asarray(lo)[0],
+        int(np.asarray(size)[0]),
+        int(np.asarray(c)[0]),
+    )
+
+
+def _run_device_merge(leaves, key, mode: str, impl: str, d: int):
+    """Pad part rows to the mesh, dispatch the memoized merger, and demote
+    Pallas -> XLA on a runtime kernel failure (same graceful-degradation
+    contract as the engine)."""
+    n_parts = leaves[0].shape[0]
+    block = -(-n_parts // d)  # parts per device
+    if impl == "pallas":
+        block = -(-block // 8) * 8  # sublane-friendly DMA blocks
+    ppad = block * d
+    if ppad != n_parts:
+        leaves = tuple(
+            np.pad(leaf, ((0, ppad - n_parts),) + ((0, 0),) * (leaf.ndim - 1))
+            for leaf in leaves
+        )
+    args = leaves + ((key,) if mode == "uniform" else ())
+    fn = _device_tree_merger(n_parts, d, mode, len(leaves), impl == "pallas")
+    if impl != "pallas":
+        return fn(*args)
+    try:
+        return fn(*args)
+    except Exception as e:
+        warn_once(
+            _flags, "_pallas_runtime_demoted_logged",
+            "Pallas collective merge failed (%s: %s); demoting to the "
+            "XLA-collective path (logged once)", type(e).__name__, e,
+            logger=__name__,
+        )
+        fn = _device_tree_merger(n_parts, d, mode, len(leaves), False)
+        return fn(*args)
 
 
 @functools.lru_cache(maxsize=None)

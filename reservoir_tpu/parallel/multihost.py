@@ -23,9 +23,9 @@ multi-host mesh.
 
 from __future__ import annotations
 
-from typing import Optional
+from typing import List, Optional
 
-__all__ = ["initialize", "is_initialized"]
+__all__ = ["initialize", "is_initialized", "spread_devices"]
 
 
 def _cluster_env_detected() -> bool:
@@ -60,6 +60,25 @@ def _cluster_env_detected() -> bool:
             "MEGASCALE_COORDINATOR_ADDRESS",
         )
         return any(m in os.environ for m in markers)
+
+
+def spread_devices(n: int) -> List:
+    """Round-robin this process's addressable devices across ``n`` slots.
+
+    The serving plane's placement helper: ``n`` shards (or any other
+    per-unit state owners) each get one ``jax.Device``, cycling through
+    ``jax.local_devices()`` so consecutive shards land on distinct chips
+    when there are enough and share fairly when there are not.  Only
+    *addressable* devices are handed out — a shard must be able to commit
+    arrays to its device, so global (other-process) devices from a joined
+    pod are never returned.
+    """
+    import jax
+
+    if n < 1:
+        raise ValueError(f"spread_devices: n must be >= 1, got {n}")
+    devs = jax.local_devices()
+    return [devs[i % len(devs)] for i in range(int(n))]
 
 
 def is_initialized() -> bool:

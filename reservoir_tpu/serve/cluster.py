@@ -88,6 +88,32 @@ def shard_of(key: str, n_shards: int, routing_epoch: int = 0) -> int:
     return h % int(n_shards)
 
 
+def _resolve_devices(devices: Optional[Any], n_shards: int) -> List[Any]:
+    """Normalize the cluster ``devices=`` knob into one entry per shard:
+    ``None`` -> all-None (backend default placement), ``"spread"`` ->
+    round-robin over the addressable devices, a sequence -> validated
+    verbatim (length must match — silent truncation would strand shards
+    on the wrong chip)."""
+    if devices is None:
+        return [None] * n_shards
+    if isinstance(devices, str):
+        if devices != "spread":
+            raise ValueError(
+                f"devices= accepts None, 'spread', or a sequence of "
+                f"{n_shards} devices; got {devices!r}"
+            )
+        from ..parallel.multihost import spread_devices
+
+        return list(spread_devices(n_shards))
+    devs = list(devices)
+    if len(devs) != n_shards:
+        raise ValueError(
+            f"devices= sequence has {len(devs)} entries for "
+            f"{n_shards} shards"
+        )
+    return devs
+
+
 class ShardedReservoirService:
     """N independent shard units behind one session-keyed front-end.
 
@@ -113,6 +139,12 @@ class ShardedReservoirService:
         :class:`ShardUnavailable` carries.
       faults: fault plane reaching the cluster's ``shard.*`` sites and
         every unit's lower-layer sites.
+      devices: per-shard device placement — ``None`` (backend default),
+        ``"spread"`` (round-robin the addressable devices via
+        :func:`~reservoir_tpu.parallel.multihost.spread_devices`), or an
+        explicit sequence of ``n_shards`` ``jax.Device``s.  Shard ``i``'s
+        engine state is pinned to its device, so :meth:`migrate` ships
+        rows device-to-device instead of through the host.
       **shard_kwargs: forwarded to every :class:`ShardUnit` (and through
         it to each :class:`ReservoirService`): ``ttl_s``, ``gated``,
         ``coalesce_bytes``, ``durability``, ``heartbeat_timeout_s``, ...
@@ -129,6 +161,7 @@ class ShardedReservoirService:
         standby: bool = True,
         retry_after_s: float = 0.05,
         faults: Optional[Any] = None,
+        devices: Optional[Any] = None,
         _units: Optional[List[ShardUnit]] = None,
         **shard_kwargs: Any,
     ) -> None:
@@ -141,6 +174,9 @@ class ShardedReservoirService:
         self._base_key = int(key)
         self._retry_after_s = float(retry_after_s)
         self._faults = faults
+        #: session-key -> shard overrides left by :meth:`migrate`; consulted
+        #: before the hash so migrated keys keep landing on their new home.
+        self._overrides: Dict[str, int] = {}
         os.makedirs(cluster_dir, exist_ok=True)
         if _units is not None:
             self._units = _units
@@ -150,6 +186,7 @@ class ShardedReservoirService:
                 encoding="utf-8",
             )
         else:
+            devs = _resolve_devices(devices, self.n_shards)
             self._units = [
                 ShardUnit(
                     config,
@@ -158,6 +195,7 @@ class ShardedReservoirService:
                     key=self.shard_seed(i),
                     standby=standby,
                     faults=faults,
+                    device=devs[i],
                     **shard_kwargs,
                 )
                 for i in range(self.n_shards)
@@ -205,7 +243,11 @@ class ShardedReservoirService:
     # -------------------------------------------------------------- routing
 
     def shard_of(self, key: str) -> int:
-        """Resolve ``key``'s shard (pure — no fault site, no journal)."""
+        """Resolve ``key``'s shard (no fault site, no journal): the
+        :meth:`migrate` override if one exists, else the pinned hash."""
+        ov = self._overrides.get(key)
+        if ov is not None:
+            return ov
         return shard_of(key, self.n_shards, self.routing_epoch)
 
     def _route(self, key: str) -> Tuple[ShardUnit, int]:
@@ -324,6 +366,109 @@ class ShardedReservoirService:
         except FencedError as e:
             self._guard(unit, shard, e)
 
+    # ------------------------------------------------------- live migration
+
+    def migrate(self, key: str, dst_shard: int) -> Any:
+        """Move ``key``'s live reservoir row to ``dst_shard`` without
+        losing an element or serving a stale row.
+
+        The move is fence-then-drain on the source (close the lease, so
+        the source row's generation bumps and any straggler touch raises
+        :class:`~reservoir_tpu.errors.StaleSessionError`), ship the row's
+        state device-to-device (``jax.device_put`` straight onto the
+        destination's pinned device; host staging when unpinned), then
+        reset-and-adopt on the destination at a journaled adopt record.
+        The routing override is journaled LAST — every crash window fails
+        CLOSED: before the record lands, ``key`` still routes to the
+        source, where the session is already closed, so a caller gets
+        :class:`~reservoir_tpu.errors.UnknownSessionError` (never a stale
+        or double-served row; at worst one orphaned lease leaks on the
+        destination until its TTL sweep).  :meth:`recover` and the
+        standbys replay the same records bit-exactly.
+
+        Returns the destination's new :class:`~.sessions.Session`.
+        """
+        import jax
+
+        dst_shard = int(dst_shard)
+        if not 0 <= dst_shard < self.n_shards:
+            raise ValueError(
+                f"dst_shard {dst_shard} out of range [0, {self.n_shards})"
+            )
+        src_unit, src_shard = self._route(key)
+        if dst_shard == src_shard:
+            raise ValueError(
+                f"session {key!r} already lives on shard {src_shard}"
+            )
+        dst_unit = self._units[dst_shard]
+        if not dst_unit.alive:
+            raise ShardUnavailable(
+                f"migration target shard {dst_shard} is "
+                f"{dst_unit.unavailable_reason or 'unavailable'}",
+                retry_after_s=self._retry_after_s,
+                shard=dst_shard,
+                reason=dst_unit.unavailable_reason or "unavailable",
+            )
+        reg = _obs.get()
+        t0 = time.perf_counter()
+        tr = _ctrace.get()
+        cm = (
+            tr.span(
+                "cluster.migrate",
+                force=True,
+                session=key,
+                src=src_shard,
+                dst=dst_shard,
+            )
+            if tr is not None
+            else contextlib.nullcontext()
+        )
+        with cm, trace_span("reservoir_cluster_migrate"):
+            try:
+                sess = src_unit.service.table.route(key)
+                elements = int(sess.elements)
+                # export drains the source first (sync inside), so the
+                # shipped state holds every ingested element
+                sub = src_unit.service.export_rows([sess.row])
+                # device-to-device when the destination is pinned; the
+                # backend default device otherwise (np staging would drop
+                # the typed per-row PRNG keys)
+                dst_dev = dst_unit.service.device
+                if dst_dev is None:
+                    dst_dev = jax.devices()[0]
+                shipped = jax.device_put(sub, dst_dev)
+                src_unit.service.close_session(key)
+            except FencedError as e:
+                self._guard(src_unit, src_shard, e)
+            try:
+                new_sess = dst_unit.service.open_session(key)
+                dst_unit.service.adopt_rows([new_sess.row], shipped)
+                new_sess.elements = elements
+            except FencedError as e:
+                self._guard(dst_unit, dst_shard, e)
+        self._overrides[key] = dst_shard
+        self._append_routing(
+            {
+                "op": "migrate",
+                "key": key,
+                "src": src_shard,
+                "dst": dst_shard,
+                "elements": elements,
+            }
+        )
+        dt = time.perf_counter() - t0
+        if reg is not None:
+            reg.histogram("cluster.migrate_s").observe(dt)
+        _obs.emit(
+            "shard.migrate",
+            site="shard.migrate",
+            session=key,
+            src=src_shard,
+            dst=dst_shard,
+            elements=elements,
+        )
+        return new_sess
+
     def sync(self) -> Dict[int, int]:
         """Barrier every LIVE shard; returns ``{shard: flushed_seq}``.
         A shard hitting its fence mid-sync is marked down and skipped —
@@ -434,7 +579,12 @@ class ShardedReservoirService:
     # ------------------------------------------------------ merged snapshots
 
     def merged_snapshot(
-        self, keys: Sequence[str], *, merge_key: int = 0, sync: bool = True
+        self,
+        keys: Sequence[str],
+        *,
+        merge_key: int = 0,
+        sync: bool = True,
+        device: Optional[str] = None,
     ) -> np.ndarray:
         """One logical uniform sample over the named sessions' combined
         streams, merged across shards with the exact mergeable-reservoir
@@ -444,7 +594,14 @@ class ShardedReservoirService:
         bit-reconcilable with a single-shard oracle merging per-session
         oracle replays with the same function.  Uniform (plain) mode
         only — weighted/distinct merges are state-keyed and ride the mesh
-        mergers in :mod:`reservoir_tpu.parallel.merge`."""
+        mergers in :mod:`reservoir_tpu.parallel.merge`.
+
+        ``device=None`` merges on the host; ``"auto"``/``"xla"``/
+        ``"pallas"`` runs the same deterministic merge tree as a device
+        collective
+        (:func:`~reservoir_tpu.parallel.merge.merge_samples_device`) —
+        bit-identical by construction, timed under
+        ``cluster.merge_device_s`` instead of ``cluster.merge_s``."""
         if self._config.weighted or self._config.distinct:
             raise ValueError(
                 "merged_snapshot is uniform-mode only: weighted/distinct "
@@ -453,7 +610,7 @@ class ShardedReservoirService:
             )
         if not keys:
             raise ValueError("merged_snapshot needs at least one session key")
-        from ..parallel.merge import merge_samples_host
+        from ..parallel.merge import merge_samples_device, merge_samples_host
 
         reg = _obs.get()
         t0 = time.perf_counter() if reg is not None else 0.0
@@ -462,13 +619,21 @@ class ShardedReservoirService:
             unit, _ = self._route(key)
             sample = unit.service.snapshot(key, sync=sync)
             parts.append((sample, unit.service.table.route(key).elements))
-        merged, _total = merge_samples_host(
-            parts, merge_key, max_sample_size=self._config.max_sample_size
-        )
-        if reg is not None:
-            reg.histogram("cluster.merge_s").observe(
-                time.perf_counter() - t0
+        if device is None:
+            merged, _total = merge_samples_host(
+                parts, merge_key, max_sample_size=self._config.max_sample_size
             )
+        else:
+            merged, _total = merge_samples_device(
+                parts,
+                merge_key,
+                max_sample_size=self._config.max_sample_size,
+                impl=device,
+            )
+            merged = np.asarray(merged)
+        if reg is not None:
+            name = "cluster.merge_s" if device is None else "cluster.merge_device_s"
+            reg.histogram(name).observe(time.perf_counter() - t0)
         return merged
 
     # -------------------------------------------------------------- recovery
@@ -481,6 +646,7 @@ class ShardedReservoirService:
         standby: bool = True,
         retry_after_s: float = 0.05,
         faults: Optional[Any] = None,
+        devices: Optional[Any] = None,
         **shard_kwargs: Any,
     ) -> "ShardedReservoirService":
         """Rebuild a crashed cluster from ``cluster_dir``.
@@ -488,13 +654,21 @@ class ShardedReservoirService:
         The routing journal's header re-pins ``(n_shards, routing_epoch,
         key)`` — the entire routing function — so every session re-routes
         identically; each replayed ``route`` record is cross-checked
-        against the hash (divergence is a hard error, it would strand
+        against the hash *with the migration overrides replayed in
+        order* (a ``migrate`` record re-homes its key exactly as the live
+        :meth:`migrate` did; divergence is a hard error, it would strand
         sessions on the wrong shard) and a torn final line is dropped
         (crash mid-append: the open it described is re-journaled by the
-        shard's own session journal or never happened).  Each shard then
-        recovers independently via :meth:`ReservoirService.recover` —
-        including the ISSUE-9 epoch pre-flight, so a shard whose lineage
-        was fenced by a promotion fails typed instead of double-serving."""
+        shard's own session journal or never happened; a torn ``migrate``
+        fails CLOSED — the key re-routes to its source, whose session
+        journal already closed the lease).  Each shard then recovers
+        independently via :meth:`ReservoirService.recover` — including
+        the ISSUE-9 epoch pre-flight, so a shard whose lineage was fenced
+        by a promotion fails typed instead of double-serving.  Element
+        counts for migrated sessions (plain session-table state, not
+        engine state) are restored from the last ``migrate`` record per
+        key.  ``devices=`` re-pins shard engines exactly as at
+        construction — placement is process-local, never journaled."""
         path = os.path.join(cluster_dir, _ROUTING_NAME)
         with open(path, encoding="utf-8") as fh:
             lines = fh.read().splitlines()
@@ -519,18 +693,39 @@ class ShardedReservoirService:
         n_shards = int(header["shards"])
         routing_epoch = int(header["routing_epoch"])
         base_key = int(header["key"])
+        overrides: Dict[str, int] = {}
+        migrated: Dict[str, dict] = {}
         for rec in records[1:]:
-            if rec.get("op") != "route":
-                raise ValueError(
-                    f"routing journal: unknown op {rec.get('op')!r}"
+            op = rec.get("op")
+            if op == "route":
+                want = overrides.get(
+                    rec["key"],
+                    shard_of(rec["key"], n_shards, routing_epoch),
                 )
-            want = shard_of(rec["key"], n_shards, routing_epoch)
-            if int(rec["shard"]) != want:
-                raise ValueError(
-                    f"routing journal replay diverged at {rec!r}: the "
-                    f"pinned routing function routes {rec['key']!r} to "
-                    f"shard {want}"
+                if int(rec["shard"]) != want:
+                    raise ValueError(
+                        f"routing journal replay diverged at {rec!r}: the "
+                        f"pinned routing function routes {rec['key']!r} to "
+                        f"shard {want}"
+                    )
+            elif op == "migrate":
+                want = overrides.get(
+                    rec["key"],
+                    shard_of(rec["key"], n_shards, routing_epoch),
                 )
+                if int(rec["src"]) != want:
+                    raise ValueError(
+                        f"routing journal replay diverged at {rec!r}: "
+                        f"{rec['key']!r} lived on shard {want}, not "
+                        f"{rec['src']}"
+                    )
+                overrides[rec["key"]] = int(rec["dst"])
+                migrated[rec["key"]] = rec
+            else:
+                raise ValueError(
+                    f"routing journal: unknown op {op!r}"
+                )
+        devs = _resolve_devices(devices, n_shards)
         units = []
         for i in range(n_shards):
             shard_dir = os.path.join(cluster_dir, f"shard{i}")
@@ -538,6 +733,7 @@ class ShardedReservoirService:
                 shard_dir,
                 obs_scope=f"shard{i}",
                 faults=faults,
+                device=devs[i],
                 **{
                     k: v
                     for k, v in shard_kwargs.items()
@@ -557,11 +753,12 @@ class ShardedReservoirService:
                     key=base_key + 7919 * i,
                     standby=standby,
                     faults=faults,
+                    device=devs[i],
                     _service=service,
                     **shard_kwargs,
                 )
             )
-        return cls(
+        inst = cls(
             units[0].service.config,
             n_shards,
             cluster_dir,
@@ -572,6 +769,14 @@ class ShardedReservoirService:
             faults=faults,
             _units=units,
         )
+        inst._overrides = overrides
+        # Session.elements is front-end bookkeeping the shard journals
+        # don't carry for an adopted row; the migrate record does.
+        for key, rec in migrated.items():
+            table = units[int(rec["dst"])].service.table
+            if key in table:
+                table.route(key).elements = int(rec["elements"])
+        return inst
 
     # -------------------------------------------------------------- teardown
 

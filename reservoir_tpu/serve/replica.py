@@ -58,7 +58,11 @@ import numpy as np
 
 from ..obs import registry as _obs
 from ..obs import trace as _ctrace
-from ..stream.bridge import DeviceStreamBridge, _FlushJournal
+from ..stream.bridge import (
+    DeviceStreamBridge,
+    _FlushJournal,
+    _unpack_adopt_payload,
+)
 from ..utils import faults as _faults
 from ..utils.checkpoint import (
     advance_epoch,
@@ -168,7 +172,9 @@ class JournalFollower:
             return False
         magic, seq, _ = _FlushJournal._HEADER.unpack(head)
         return magic in (
-            _FlushJournal._MAGIC, _FlushJournal._MAGIC_GATED
+            _FlushJournal._MAGIC,
+            _FlushJournal._MAGIC_GATED,
+            _FlushJournal._MAGIC_ADOPT,
         ) and seq == self._offset_seq
 
     def poll(
@@ -586,7 +592,14 @@ class StandbyReplica:
                     else contextlib.nullcontext()
                 )
                 with acm, trace_span("reservoir_replica_apply"):
-                    if advance is not None:
+                    if advance is _FlushJournal.ADOPT:
+                        # adopt frame (ISSUE 12): a live migration landed
+                        # rows on the primary — re-apply them here at the
+                        # same position between flushes
+                        rows, sub = _unpack_adopt_payload(tile)
+                        self._engine.adopt_rows(rows, sub)
+                        self._service._reset_epoch += 1
+                    elif advance is not None:
                         self._engine.sample_gated(tile, valid, advance)
                     else:
                         self._engine.sample(tile, valid=valid, weights=wtile)

@@ -143,7 +143,9 @@ class ReservoirService:
         checkpoint_every / durability / faults / gated / gate_tile:
         forwarded to the underlying :class:`DeviceStreamBridge` (the
         ISSUE-3/5 robustness plane; ``gated`` is the ISSUE-8 ingest-side
-        skip gate).  With ``checkpoint_dir`` set the service additionally
+        skip gate; ``gate_tile=0`` resolves the tile width from the
+        autotune cache, 64 when untuned).  With ``checkpoint_dir`` set
+        the service additionally
         journals the session map to ``sessions.jsonl`` there, which is
         what makes :meth:`recover` (and hot-standby replication,
         :class:`~reservoir_tpu.serve.replica.StandbyReplica`) possible.
@@ -153,6 +155,8 @@ class ReservoirService:
         gate changes neither the rejection threshold nor what
         ``ServiceSaturated.retry_after_s`` means (pinned by
         ``tests/test_gate.py``).
+      device: pin this service's engine (state + flushes) to one device
+        (ISSUE 12 per-shard placement; forwarded to the bridge).
     """
 
     def __init__(
@@ -177,6 +181,7 @@ class ReservoirService:
         faults: Optional[Any] = None,
         gated: bool = False,
         gate_tile: int = 64,
+        device: Optional[Any] = None,
         _bridge: Optional[DeviceStreamBridge] = None,
         _table: Optional[SessionTable] = None,
     ) -> None:
@@ -203,6 +208,7 @@ class ReservoirService:
             faults=faults,
             gated=gated,
             gate_tile=gate_tile,
+            device=device,
         )
         config = self._bridge._config
         self._config = config
@@ -277,6 +283,12 @@ class ReservoirService:
     def flushed_seq(self) -> int:
         """The underlying bridge's durable flush watermark."""
         return self._bridge.flushed_seq
+
+    @property
+    def device(self) -> Optional[Any]:
+        """The device this service's engine is pinned to (``None`` when
+        unpinned)."""
+        return self._bridge.device
 
     def _scoped(self, name: str) -> str:
         """Instrument name under this service's per-shard scope (ISSUE 9);
@@ -654,6 +666,25 @@ class ReservoirService:
         self._bridge.drain_barrier()
         return self._bridge.flushed_seq
 
+    # ------------------------------------------------------- live migration
+
+    def export_rows(self, rows: Any) -> Any:
+        """Drain everything pending, then export the state of ``rows`` as
+        a fresh pytree (the source half of a live migration, ISSUE 12).
+        The sync barrier first makes the export a consistent cut: every
+        accepted element for those rows is reflected in it."""
+        self.sync()
+        return self._bridge.engine.export_rows(rows)
+
+    def adopt_rows(self, rows: Any, sub_state: Any) -> None:
+        """Adopt exported reservoir rows into this service's engine (the
+        destination half of a live migration, ISSUE 12).  Journaled as one
+        RTJA frame by the bridge; the snapshot cache epoch bumps so no
+        cached read can serve the rows' previous contents."""
+        self.sync()  # pending elements precede the adopt (stream order)
+        self._bridge.adopt_rows(rows, sub_state)
+        self._reset_epoch += 1
+
     # ------------------------------------------------------------ snapshots
 
     def snapshot(self, key: str, sync: bool = True) -> np.ndarray:
@@ -733,6 +764,7 @@ class ReservoirService:
         checkpoint_every: Optional[int] = None,
         durability: Optional[str] = None,
         faults: Optional[Any] = None,
+        device: Optional[Any] = None,
     ) -> "ReservoirService":
         """Rebuild a crashed service from ``checkpoint_dir``.
 
@@ -808,6 +840,7 @@ class ReservoirService:
             durability=durability,
             faults=faults,
             replay_hook=replay_hook,
+            device=device,
         )
         if bridge._config.num_reservoirs != table.capacity:
             # recovery pre-flight (ISSUE-5 satellite): the two journals

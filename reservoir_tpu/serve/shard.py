@@ -84,6 +84,9 @@ class ShardUnit:
         scoping is not.
       faults: fault plane for this unit's sites (``shard.promote`` fires
         here; the cluster fires ``shard.route``).
+      device: pin this shard's engine state to one ``jax.Device``
+        (threaded into the service, and re-applied on :meth:`recover`).
+        ``None`` keeps the backend default placement.
       **service_kwargs: forwarded to :class:`ReservoirService`
         (``ttl_s``, ``coalesce_bytes``, ``gated``, ``durability``, ...).
     """
@@ -105,12 +108,14 @@ class ShardUnit:
         slo_kwargs: Optional[dict] = None,
         faults: Optional[Any] = None,
         retry_policy: Optional[RetryPolicy] = None,
+        device: Optional[Any] = None,
         _service: Optional[ReservoirService] = None,
         **service_kwargs: Any,
     ) -> None:
         self.shard_id = int(shard_id)
         self.checkpoint_dir = checkpoint_dir
         self.engine_seed = key
+        self.device = device
         self._config = config
         self._standby_enabled = bool(standby)
         self._clock = clock
@@ -127,6 +132,8 @@ class ShardUnit:
         )
         self._service_kwargs = dict(service_kwargs)
         self._service_kwargs.setdefault("retry_policy", retry_policy)
+        if device is not None:
+            self._service_kwargs["device"] = device
         if _service is not None:
             # adoption path (cluster recover): the service was rebuilt by
             # ReservoirService.recover and already owns the directory
@@ -311,7 +318,7 @@ class ShardUnit:
                 "ttl_s", "coalesce_bytes", "max_inflight_bytes",
                 "retry_after_s", "sweep_interval_s", "auditor",
                 "retry_policy", "flush_timeout_s", "checkpoint_every",
-                "durability", "pipelined",
+                "durability", "pipelined", "device",
             )
             if k in self._service_kwargs
         }

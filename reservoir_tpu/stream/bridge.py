@@ -44,6 +44,8 @@ writer.  Wrap pushes in your own queue for multi-producer feeds.
 from __future__ import annotations
 
 import contextlib
+import io
+import json
 import os
 import queue
 import struct
@@ -326,11 +328,27 @@ class _FlushJournal:
     every appended frame (and the file+directory on rotation), closing the
     OS/power-crash window the buffered default concedes above — at the
     cost of one fsync per flush, counted through ``sync_cb``.
+
+    Live migration (ISSUE 12) adds **adopt frames** (``MAGIC = RTJA``):
+    the payload is a self-describing npz blob carrying the adopted row
+    indices plus the packed row state (the destination half of
+    :meth:`DeviceStreamBridge.adopt_rows`).  Readers surface it with the
+    :data:`ADOPT` sentinel in the ``advance`` slot and the raw payload in
+    the ``tile`` slot; replay re-applies it through
+    :meth:`ReservoirEngine.adopt_rows` at its original position between
+    flushes — the bit-exactness contract extends across migrations.
     """
 
     _MAGIC = b"RTJL"
     _MAGIC_GATED = b"RTJG"
+    _MAGIC_ADOPT = b"RTJA"
     _HEADER = struct.Struct("<4sQI")
+
+    #: Sentinel yielded in the ``advance`` slot of :meth:`read_records` /
+    #: :meth:`replay` for adopt frames (the ``tile`` slot then holds the
+    #: raw payload bytes) — check it BEFORE the ``advance is not None``
+    #: gated-frame test.
+    ADOPT = "adopt"
 
     def __init__(
         self,
@@ -386,6 +404,11 @@ class _FlushJournal:
         share of the bytes-elided win."""
         payload = nvalid.tobytes() + advance.tobytes() + tile.tobytes()
         self._append_frame(self._MAGIC_GATED, seq, payload)
+
+    def append_adopt(self, seq: int, payload: bytes) -> None:
+        """One adopt frame (ISSUE 12): the packed row-adoption blob from
+        :func:`_pack_adopt_payload` — a migration's durable record."""
+        self._append_frame(self._MAGIC_ADOPT, seq, payload)
 
     def _append_frame(self, magic: bytes, seq: int, payload: bytes) -> None:
         self._fh.write(self._HEADER.pack(magic, seq, len(payload)))
@@ -465,6 +488,8 @@ class _FlushJournal:
                     rem = plen - 2 * n_valid
                     if rem < 0 or rem % (S * dtype.itemsize):
                         return
+                elif magic == cls._MAGIC_ADOPT:
+                    pass  # self-describing payload; CRC is the only check
                 else:
                     return
                 payload = fh.read(plen)
@@ -473,6 +498,11 @@ class _FlushJournal:
                     return
                 if zlib.crc32(payload) != struct.unpack("<I", crc)[0]:
                     return
+                if magic == cls._MAGIC_ADOPT:
+                    # adopt frame (ISSUE 12): raw payload in the tile slot,
+                    # the ADOPT sentinel in the advance slot
+                    yield fh.tell(), int(seq), payload, None, None, cls.ADOPT
+                    continue
                 if magic == cls._MAGIC_GATED:
                     bg = (plen - 2 * n_valid) // (S * dtype.itemsize)
                     nvalid = np.frombuffer(payload, np.int32, S).copy()
@@ -517,6 +547,39 @@ class _FlushJournal:
             path, num_streams, tile_width, dtype, weighted
         ):
             yield seq, tile, valid, wtile, advance
+
+
+def _pack_adopt_payload(rows: np.ndarray, sub_state: Any) -> bytes:
+    """Serialize one row adoption (indices + packed row state) into a
+    self-describing npz blob for the RTJA journal frame.  Reuses the
+    checkpoint packer, so typed PRNG keys round-trip as key-data words and
+    replay reconstructs the exact state pytree the live adopt applied."""
+    from ..utils.checkpoint import _pack_state
+
+    arrays, manifest = _pack_state(sub_state)
+    bio = io.BytesIO()
+    np.savez(
+        bio,
+        __rows__=np.ascontiguousarray(rows, np.int32),
+        __manifest__=np.frombuffer(json.dumps(manifest).encode(), np.uint8),
+        **arrays,
+    )
+    return bio.getvalue()
+
+
+def _unpack_adopt_payload(payload: bytes) -> Tuple[np.ndarray, Any]:
+    """Inverse of :func:`_pack_adopt_payload`: ``(rows, sub_state)``."""
+    from ..utils.checkpoint import _unpack_state
+
+    with np.load(io.BytesIO(payload)) as data:
+        manifest = json.loads(bytes(data["__manifest__"]).decode())
+        rows = np.ascontiguousarray(data["__rows__"], np.int32)
+        arrays = {
+            k: data[k]
+            for k in data.files
+            if k not in ("__rows__", "__manifest__")
+        }
+    return rows, _unpack_state(arrays, manifest)
 
 
 class DeviceStreamBridge:
@@ -582,6 +645,10 @@ class DeviceStreamBridge:
         candidates; acceptance-free flushes coalesce until some row's
         buffer fills or a visibility barrier (:meth:`flush`,
         :meth:`complete`, a serve-plane ``sync``) forces the dispatch.
+        ``0`` resolves the width from the persistent autotune cache
+        (``kernel="gate"``, populated by ``tools/tpu_block_sweep.py
+        --kernel gate``), falling back to 64 when untuned — same for
+        ``gate_push_chunk=0`` (fallback 1 Mi).
       gate_push_chunk: slice width of the PRE-staging push fast path
         (default 1 Mi elements): a row-contiguous :meth:`push` chunk is
         gated in slices of this many elements — one vectorized recursion
@@ -591,6 +658,9 @@ class DeviceStreamBridge:
         automatically reroutes through the staged path; wide slices
         amortize the per-eval call cost, which dominates the gated hot
         path once everything else is elided.
+      device: pin the engine (state + every staged flush) to one device
+        (ISSUE 12, per-shard placement).  Mutually exclusive with
+        ``mesh``; ``None`` keeps jax's default placement.
     """
 
     def __init__(
@@ -612,6 +682,7 @@ class DeviceStreamBridge:
         gated: bool = False,
         gate_tile: int = 64,
         gate_push_chunk: int = 1 << 20,
+        device: Optional[Any] = None,
         _engine: Optional[ReservoirEngine] = None,
     ) -> None:
         if durability not in ("buffered", "fsync"):
@@ -621,7 +692,11 @@ class DeviceStreamBridge:
         self._config = config
         self._faults = faults
         # _engine is the recovery path (recover() restores it from the
-        # checkpoint); normal construction builds a fresh one
+        # checkpoint); normal construction builds a fresh one.  device=
+        # (ISSUE 12) pins the engine to one chip — the per-shard placement
+        # that gives the collective merge real interconnect to cross; a
+        # recovered engine is pinned after the fact (placement is
+        # process-local, never persisted in the checkpoint).
         self._engine = _engine if _engine is not None else ReservoirEngine(
             config,
             key=key,
@@ -630,7 +705,10 @@ class DeviceStreamBridge:
             reusable=reusable,
             mesh=mesh,
             faults=faults,
+            device=device,
         )
+        if _engine is not None and device is not None:
+            self._engine._pin_device(device)
         self._reusable = reusable
         S, B = config.num_reservoirs, config.tile_size
         # staging is native (C++ demux, _native/staging_buffer.cc) when the
@@ -673,7 +751,22 @@ class DeviceStreamBridge:
             )
         # ingest-side skip-ahead gate (ISSUE 8): constructed only when
         # requested AND eligible — an inert gate costs nothing, an active
-        # one evaluates the host replica per flush and coalesces candidates
+        # one evaluates the host replica per flush and coalesces candidates.
+        # 0 = resolve from the persistent autotune cache (kernel="gate",
+        # ISSUE 12 satellite) with the historical defaults as fallback, so
+        # a sweep winner becomes the live geometry without a code change.
+        if gate_tile == 0 or gate_push_chunk == 0:
+            geo = self._gate_geometry(B, dtype)
+            if gate_tile == 0:
+                gate_tile = (
+                    geo.gate_tile if geo is not None and geo.gate_tile else 64
+                )
+            if gate_push_chunk == 0:
+                gate_push_chunk = (
+                    geo.gate_push_chunk
+                    if geo is not None and geo.gate_push_chunk
+                    else 1 << 20
+                )
         self._gate: Optional[SkipGate] = None
         self._gate_reason: Optional[str] = None
         if gated:
@@ -736,6 +829,28 @@ class DeviceStreamBridge:
                 # then simply grows from here
                 self._save_snapshot()
 
+    def _gate_geometry(self, width: int, dtype):
+        """Tuned gate geometry for this shape from the persistent autotune
+        cache (``kernel="gate"`` — ``tools/tpu_block_sweep.py --kernel
+        gate`` populates it), or None: callers then keep the historical
+        defaults, so untuned devices behave exactly as before."""
+        import jax
+
+        from ..ops import autotune
+
+        try:
+            device_kind = jax.devices()[0].device_kind
+        except Exception:  # backend init failure surfaces elsewhere
+            return None
+        return autotune.lookup(
+            device_kind,
+            self._config.num_reservoirs,
+            self._config.max_sample_size,
+            width,
+            dtype,
+            kernel="gate",
+        )
+
     # ------------------------------------------------------------ properties
 
     @property
@@ -749,6 +864,12 @@ class DeviceStreamBridge:
         contract: call :meth:`drain_barrier` before touching engine state
         while a pipelined flush may be in flight."""
         return self._engine
+
+    @property
+    def device(self) -> Optional[Any]:
+        """The device this bridge's engine is pinned to (``None`` when
+        unpinned — jax's default placement)."""
+        return self._engine.device
 
     @property
     def sample(self) -> Future:
@@ -943,6 +1064,46 @@ class DeviceStreamBridge:
         self._metrics.flushed_elements += n
         self._metrics.flushes += 1
         self._metrics.demotions = self._engine.demotions
+        self._maybe_checkpoint()
+
+    def adopt_rows(self, rows: Any, sub_state: Any) -> None:
+        """Adopt exported reservoir rows into this bridge's engine — the
+        destination half of a live migration (ISSUE 12).
+
+        ``sub_state`` is the pytree returned by the source engine's
+        :meth:`~reservoir_tpu.engine.ReservoirEngine.export_rows`; leaves
+        may still live on the source device (the engine re-commits them
+        onto this bridge's device).  The adopt is fence-checked, runs
+        under the single-writer slot (gated candidate buffers dispatch
+        first, in-flight flushes drain), consumes one flush sequence
+        number, and — on journaling bridges — is durably recorded as one
+        RTJA frame BEFORE it mutates the engine, so :meth:`recover` and a
+        :class:`~reservoir_tpu.serve.replica.StandbyReplica` replay it
+        bit-exactly at its original position between flushes.
+        """
+        self._check_open()
+        self._check_fence()
+        if self._gate is not None:
+            # stream order: everything the gate buffered precedes the
+            # adopt; the replica re-pulls before the next gated eval
+            self._dispatch_gated_pending()
+            self._gate.mark_dirty()
+        self.drain_barrier()  # engine is single-writer
+        self._flush_seq += 1
+        if self._journal is not None:
+            reg = _obs.get()
+            t0 = time.perf_counter() if reg is not None else 0.0
+            with trace_span("reservoir_journal_append"):
+                self._journal.append_adopt(
+                    self._flush_seq, _pack_adopt_payload(rows, sub_state)
+                )
+            if reg is not None:
+                reg.histogram("bridge.journal_append_s").observe(
+                    time.perf_counter() - t0
+                )
+        with trace_span("reservoir_bridge_adopt"):
+            self._engine.adopt_rows(rows, sub_state)
+        self._metrics.flushes += 1
         self._maybe_checkpoint()
 
     def _dispatch_flush(self, tile, valid, wtile, advance=None) -> None:
@@ -1528,6 +1689,7 @@ class DeviceStreamBridge:
         gated: Optional[bool] = None,
         gate_tile: Optional[int] = None,
         replay_hook: Optional[Any] = None,
+        device: Optional[Any] = None,
     ) -> "DeviceStreamBridge":
         """Reconstruct a crashed auto-checkpointing bridge from its
         ``checkpoint_dir`` and replay the journaled post-checkpoint tail.
@@ -1582,6 +1744,10 @@ class DeviceStreamBridge:
                 "(its post-promotion handoff checkpoint) instead"
             )
         engine._faults = faults
+        if device is not None:
+            # placement is process-local: re-pin before any replay so the
+            # replayed flushes land on the same chip the live path uses
+            engine._pin_device(device)
         bridge = cls(
             engine.config,
             map_fn=map_fn,
@@ -1634,7 +1800,14 @@ class DeviceStreamBridge:
         ):
             if seq <= covered:
                 continue
-            if advance is not None:
+            if advance is _FlushJournal.ADOPT:
+                # adopt frame (ISSUE 12): re-apply the migrated rows at
+                # their original position between flushes — ``tile`` is
+                # the raw payload
+                rows, sub = _unpack_adopt_payload(tile)
+                engine.adopt_rows(rows, sub)
+                total = 0
+            elif advance is not None:
                 # gated frame (ISSUE 8): candidates + per-row advance
                 # replay through the same gated apply the live path used
                 engine.sample_gated(tile, valid, advance)

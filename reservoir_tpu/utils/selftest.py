@@ -200,6 +200,82 @@ def _check_gated(interpret: bool) -> bool:
     )
 
 
+def _check_merge(interpret: bool) -> bool:
+    """Device-collective-vs-host merge-tree bit-parity (ISSUE 12).
+
+    ``merge_samples_device`` on ``impl="auto"`` — the Pallas
+    ``make_async_remote_copy`` ring on TPU, XLA ``all_gather`` elsewhere —
+    must match the host pairwise tree bit-for-bit across all three modes
+    and a non-power-of-two part count (the odd-leftover carry is the tree
+    shape most worth pinning on real interconnect)."""
+    import numpy as np
+
+    import jax.random as jr
+
+    from ..ops import distinct as dd
+    from ..ops import weighted as ww
+    from ..parallel.merge import merge_samples_device, merge_samples_host
+
+    del interpret  # same shapes everywhere: the collective is plain XLA
+    k, n_parts = 8, 5
+    rng = np.random.default_rng(21)
+    uparts = [
+        (rng.integers(0, 1 << 30, k).astype(np.int32), int(rng.integers(k, 6 * k)))
+        for _ in range(n_parts)
+    ]
+    want, wt = merge_samples_host(uparts, 17, max_sample_size=k)
+    got, gt = merge_samples_device(uparts, 17, max_sample_size=k)
+    if gt != wt or not np.array_equal(got, want):
+        return False
+    wparts = []
+    for p in range(n_parts):
+        st = ww.update(
+            ww.init(jr.key(200 + p), 1, k),
+            (p * 1000 + np.arange(3 * k, dtype=np.int32))[None],
+            (1.0 + np.arange(3 * k, dtype=np.float32) % 7)[None],
+        )
+        wparts.append(
+            (
+                np.asarray(st.samples)[0],
+                np.asarray(st.lkeys)[0],
+                int(np.asarray(st.count)[0]),
+            )
+        )
+    for a, b in zip(
+        merge_samples_device(wparts, max_sample_size=k, mode="weighted"),
+        merge_samples_device(
+            wparts, max_sample_size=k, mode="weighted", impl="host"
+        ),
+    ):
+        if not np.array_equal(np.asarray(a), np.asarray(b)):
+            return False
+    dparts = []
+    for p in range(n_parts):
+        st = dd.update(
+            dd.init(jr.key(77), 1, k),  # shared salts: one logical stream
+            (p * 1000 + np.arange(4 * k, dtype=np.int32))[None],
+        )
+        dparts.append(
+            (
+                np.asarray(st.values)[0],
+                np.asarray(st.hash_hi)[0],
+                np.asarray(st.hash_lo)[0],
+                int(np.asarray(st.size)[0]),
+                int(np.asarray(st.count)[0]),
+                np.asarray(st.salts)[0],
+            )
+        )
+    for a, b in zip(
+        merge_samples_device(dparts, max_sample_size=k, mode="distinct"),
+        merge_samples_device(
+            dparts, max_sample_size=k, mode="distinct", impl="host"
+        ),
+    ):
+        if not np.array_equal(np.asarray(a), np.asarray(b)):
+            return False
+    return True
+
+
 def _check_ks(interpret: bool):
     """On-backend statistical-quality gate: pooled one-sample KS of the
     device sampler's output against the exact uniform law, at the literal
@@ -293,7 +369,8 @@ def device_selftest(emit_partial=None) -> Dict[str, Any]:
 
     Returns ``{"platform": ..., "algl": bool, "algl_fill": bool,
     "distinct": bool, "weighted": bool, "pallas_parity": bool,
-    "gated_parity": bool, "ks_ok": bool, ["ks_uniform": float],
+    "gated_parity": bool, "merge_parity": bool,
+    "ks_ok": bool, ["ks_uniform": float],
     "ks_distinct_ok": bool, ["ks_distinct": float],
     "ks_weighted_ok": bool, ["ks_weighted": float],
     ["<name>_error": str], ["ks*_error": str]}`` — never raises; a crash
@@ -346,6 +423,15 @@ def device_selftest(emit_partial=None) -> Dict[str, Any]:
     except Exception as e:
         out["gated_parity"] = False
         out["gated_parity_error"] = f"{type(e).__name__}: {e}"[:500]
+    _stage_done()
+    # device-collective-vs-host merge-tree parity (ISSUE 12): on TPU this
+    # is the Pallas ring permute's bit evidence; separate key so a
+    # collective regression can't erase the kernel parity bits above
+    try:
+        out["merge_parity"] = bool(_check_merge(interpret))
+    except Exception as e:
+        out["merge_parity"] = False
+        out["merge_parity_error"] = f"{type(e).__name__}: {e}"[:500]
     _stage_done()
     try:
         out["ks_uniform"], out["ks_ok"] = _check_ks(interpret)

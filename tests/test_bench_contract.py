@@ -133,6 +133,51 @@ def test_bench_shards_row_reports_per_shard_failover_and_merge():
     assert "cluster.merge_s" in stages["telemetry"]
 
 
+def test_bench_merge_row_reports_ab_and_migration_rehearsal():
+    # the ISSUE-12 acceptance surface: `bench.py merge` must run the
+    # device-vs-host merge A/B end-to-end on CPU (XLA collective path)
+    # with bit-identity asserted in-run, rehearse randomized live
+    # migrations under loadgen traffic with stale-read probes, and its
+    # row must carry both merge latency populations + the migration
+    # quantiles — the stable column names watcher captures parse.  One
+    # rep + a small migration budget: the row contract is shape, not
+    # statistics — keep the tier-1 budget lean
+    rec = _run_bench(
+        {
+            "RESERVOIR_BENCH_CONFIG": "merge",
+            "RESERVOIR_BENCH_REPS": "1",
+            "RESERVOIR_BENCH_MIGRATIONS": "4",
+        }
+    )
+    assert "merge_device_feed" in rec["metric"]
+    assert rec["value"] > 0
+    assert rec["device_impl"] in ("xla", "pallas")
+    assert rec["host_p99_ms"] > 0 and rec["device_p99_ms"] > 0
+    assert rec["migration_p99_ms"] > 0
+    assert rec["migrations"] >= 4
+    assert rec["stale_reads"] == 0
+    stages = rec["stages"]
+    for col in (
+        "shards", "per_shard_rows", "sessions", "merge_groups", "elements",
+        "device_impl", "host_p50_ms", "host_p99_ms", "device_p50_ms",
+        "device_p99_ms", "merge_speedup_p50", "bit_identical",
+        "retrace_free", "migrations", "stale_reads", "migration_p50_ms",
+        "migration_p99_ms",
+    ):
+        assert col in stages, col
+    # the row only exists if every device merge matched the host tree
+    # bit-for-bit and the host pairwise jit never re-traced
+    assert stages["bit_identical"] is True
+    assert stages["retrace_free"] is True
+    assert stages["host_p50_ms"] <= stages["host_p99_ms"]
+    assert stages["migration_p50_ms"] <= stages["migration_p99_ms"]
+    # both merge paths and the migration span feed the telemetry plane
+    for name in (
+        "cluster.merge_s", "cluster.merge_device_s", "cluster.migrate_s",
+    ):
+        assert name in stages["telemetry"]
+
+
 def test_bench_gated_row_reports_ab_and_skip_fraction():
     # the ISSUE-8 acceptance surface: `bench.py gated` must run the
     # gated-vs-ungated A/B end-to-end on CPU with bit-identity asserted

@@ -1,0 +1,428 @@
+"""Device-side collective merge + live lease migration (ISSUE 12).
+
+The tentpole contract, pinned on the CPU backend (8 virtual devices, so
+the XLA-collective path is real; the Pallas ring rides the same tree and
+is covered on hardware by the ``migrate_rehearsal`` tpu_watch post-step):
+
+- **bit-reconciliation** — ``merge_samples_device`` is the SAME
+  deterministic node-numbered log-depth merge tree as
+  ``merge_samples_host``; for every mode (uniform / weighted / distinct)
+  and part count (1, 2, 3, non-power-of-two, partial fills) the
+  collective result is bit-identical to the host tree, and a forced
+  ``impl="pallas"`` demotes gracefully off-TPU without changing a bit;
+- **live migration** — ``ShardedReservoirService.migrate`` moves a live
+  reservoir row between shards mid-stream with no stale read and no
+  double-serve: the migrated cluster reconciles bit-exactly with an
+  unmigrated oracle cluster, ``recover()`` replays the migrate record
+  (override + at-migration elements watermark + adopted state), a hot
+  standby tails the adopt frame and promotes bit-exactly, and the
+  routing override survives close/reopen cycles;
+- **placement** — ``devices="spread"`` / explicit device lists pin shard
+  engines round-robin across the local devices (the substrate the
+  device-to-device ship path runs on).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import jax
+import jax.random as jr
+
+from reservoir_tpu import SamplerConfig
+from reservoir_tpu.errors import ShardUnavailable, UnknownSessionError
+from reservoir_tpu.ops import distinct as dd
+from reservoir_tpu.ops import weighted as wd
+from reservoir_tpu.parallel.merge import (
+    host_pairwise_trace_count,
+    merge_samples_device,
+    merge_samples_host,
+)
+from reservoir_tpu.parallel.multihost import spread_devices
+from reservoir_tpu.serve import ShardedReservoirService
+
+needs_devices = pytest.mark.skipif(
+    len(jax.devices()) < 2, reason="collective path needs >= 2 devices"
+)
+
+
+def _cfg(**kw):
+    kw.setdefault("max_sample_size", 3)
+    kw.setdefault("num_reservoirs", 4)
+    kw.setdefault("tile_size", 8)
+    return SamplerConfig(**kw)
+
+
+def _uniform_parts(n_parts: int, k: int, seed: int = 0, partial=False):
+    """``(sample, count)`` parts — snapshot-shaped 1-D arrays.  With
+    ``partial`` some parts are under-filled (count < k -> short sample)."""
+    rng = np.random.default_rng(seed)
+    parts = []
+    for p in range(n_parts):
+        n = int(rng.integers(1, k)) if partial and p % 2 else int(
+            rng.integers(k, 4 * k)
+        )
+        parts.append(
+            (rng.integers(0, 1 << 30, min(n, k)).astype(np.int32), n)
+        )
+    return parts
+
+
+# ------------------------------------------------- uniform reconciliation
+
+
+@needs_devices
+@pytest.mark.parametrize("n_parts", [1, 2, 3, 5, 7])
+@pytest.mark.parametrize("partial", [False, True])
+def test_uniform_device_merge_is_bit_identical_to_host(n_parts, partial):
+    k = 4
+    parts = _uniform_parts(n_parts, k, seed=n_parts + 10 * partial,
+                           partial=partial)
+    want, want_total = merge_samples_host(parts, 7, max_sample_size=k)
+    got, got_total = merge_samples_device(
+        parts, 7, max_sample_size=k, impl="xla"
+    )
+    assert got_total == want_total
+    assert got.dtype == want.dtype
+    assert np.array_equal(got, want), (got, want)
+
+
+@needs_devices
+def test_uniform_accepts_prng_key_and_matches_int_seed():
+    k = 4
+    parts = _uniform_parts(3, k, seed=2)
+    a, _ = merge_samples_device(parts, 9, max_sample_size=k, impl="xla")
+    b, _ = merge_samples_device(
+        parts, jr.key(9), max_sample_size=k, impl="xla"
+    )
+    assert np.array_equal(a, b)
+    with pytest.raises(ValueError, match="merge key"):
+        merge_samples_device(parts, max_sample_size=k)
+
+
+def test_uniform_host_demotion_is_exactly_the_host_path():
+    # impl="host" (and single-part inputs on any impl) must BE
+    # merge_samples_host — same bits, not merely statistically alike
+    k = 4
+    parts = _uniform_parts(4, k, seed=3)
+    want, want_total = merge_samples_host(parts, 11, max_sample_size=k)
+    got, got_total = merge_samples_device(
+        parts, 11, max_sample_size=k, impl="host"
+    )
+    assert got_total == want_total and np.array_equal(got, want)
+    one = _uniform_parts(1, k, seed=4)
+    w1, t1 = merge_samples_host(one, 5, max_sample_size=k)
+    g1, tt1 = merge_samples_device(one, 5, max_sample_size=k, impl="xla")
+    assert tt1 == t1 and np.array_equal(g1, w1)
+
+
+@needs_devices
+def test_pallas_demotes_gracefully_off_tpu_without_changing_bits():
+    k = 4
+    parts = _uniform_parts(5, k, seed=6)
+    want, _ = merge_samples_host(parts, 13, max_sample_size=k)
+    got, _ = merge_samples_device(
+        parts, 13, max_sample_size=k, impl="pallas"
+    )
+    assert np.array_equal(got, want)
+
+
+def test_host_pairwise_is_memoized_no_retrace_on_repeat():
+    k = 4
+    parts = _uniform_parts(4, k, seed=8)
+    merge_samples_host(parts, 1, max_sample_size=k)
+    traces = host_pairwise_trace_count("uniform")
+    merge_samples_host(parts, 2, max_sample_size=k)  # same shapes
+    assert host_pairwise_trace_count("uniform") == traces
+
+
+def test_rejects_bad_mode_impl_and_empty_parts():
+    with pytest.raises(ValueError, match="mode"):
+        merge_samples_device([], 0, max_sample_size=3, mode="nope")
+    with pytest.raises(ValueError, match="at least one part"):
+        merge_samples_device([], 0, max_sample_size=3)
+    with pytest.raises(ValueError, match="impl"):
+        merge_samples_device(
+            _uniform_parts(2, 3), 0, max_sample_size=3, impl="cuda"
+        )
+
+
+# --------------------------------------- weighted / distinct reconciliation
+
+
+def _weighted_parts(n_parts: int, k: int):
+    parts = []
+    for p in range(n_parts):
+        n = 2 * k + p
+        st = wd.update(
+            wd.init(jr.key(100 + p), 1, k),
+            (p * 1000 + np.arange(n, dtype=np.int32))[None],
+            (1.0 + np.arange(n, dtype=np.float32) % 5)[None],
+        )
+        parts.append(
+            (
+                np.asarray(st.samples)[0],
+                np.asarray(st.lkeys)[0],
+                int(np.asarray(st.count)[0]),
+            )
+        )
+    return parts
+
+
+def _distinct_parts(n_parts: int, k: int):
+    # shards of ONE logical stream: a shared init key -> shared salts
+    parts = []
+    for p in range(n_parts):
+        st = dd.update(
+            dd.init(jr.key(42), 1, k),
+            (p * 1000 + np.arange(3 * k + p, dtype=np.int32))[None],
+        )
+        parts.append(
+            (
+                np.asarray(st.values)[0],
+                np.asarray(st.hash_hi)[0],
+                np.asarray(st.hash_lo)[0],
+                int(np.asarray(st.size)[0]),
+                int(np.asarray(st.count)[0]),
+                np.asarray(st.salts)[0],
+            )
+        )
+    return parts
+
+
+@needs_devices
+@pytest.mark.parametrize("n_parts", [2, 3, 5])
+def test_weighted_device_merge_matches_host_tree(n_parts):
+    k = 4
+    parts = _weighted_parts(n_parts, k)
+    ws, wl, wt = merge_samples_device(
+        parts, max_sample_size=k, mode="weighted", impl="host"
+    )
+    gs, gl, gt = merge_samples_device(
+        parts, max_sample_size=k, mode="weighted", impl="xla"
+    )
+    assert gt == wt
+    assert np.array_equal(gs, ws)
+    assert np.array_equal(gl, wl)
+
+
+@needs_devices
+@pytest.mark.parametrize("n_parts", [2, 3, 5])
+def test_distinct_device_merge_matches_host_tree(n_parts):
+    k = 4
+    parts = _distinct_parts(n_parts, k)
+    want = merge_samples_device(
+        parts, max_sample_size=k, mode="distinct", impl="host"
+    )
+    got = merge_samples_device(
+        parts, max_sample_size=k, mode="distinct", impl="xla"
+    )
+    assert got[3] == want[3] and got[4] == want[4]  # size, total
+    for g, w in zip(got[:3], want[:3]):
+        assert np.array_equal(g, w)
+
+
+def test_state_parts_reject_malformed_tuples():
+    k = 4
+    with pytest.raises(ValueError, match="3-tuples"):
+        merge_samples_device(
+            [(np.zeros(k, np.int32),)],
+            max_sample_size=k,
+            mode="weighted",
+        )
+    with pytest.raises(ValueError, match="state rows"):
+        merge_samples_device(
+            [
+                (
+                    np.zeros(k + 1, np.int32),
+                    np.zeros(k, np.float32),
+                    3,
+                )
+            ],
+            max_sample_size=k,
+            mode="weighted",
+        )
+
+
+# ------------------------------------------------------- live migration
+
+
+def _key_for_shard(cluster, shard, prefix="k"):
+    for i in range(10_000):
+        key = f"{prefix}{i}"
+        if cluster.shard_of(key) == shard:
+            return key
+    raise AssertionError("no key found for shard")
+
+
+def test_migrate_mid_stream_reconciles_with_unmigrated_oracle(tmp_path):
+    devs = jax.local_devices()
+    cl = ShardedReservoirService(
+        _cfg(), 2, str(tmp_path / "cl"), key=7, standby=False,
+        devices=[devs[0], devs[-1]],
+    )
+    orc = ShardedReservoirService(
+        _cfg(), 2, str(tmp_path / "orc"), key=7, standby=False
+    )
+    key = _key_for_shard(cl, 0, prefix="m")
+    first = (1000 + np.arange(30)).astype(np.int32)
+    second = (5000 + np.arange(30)).astype(np.int32)
+    for c in (cl, orc):
+        c.open_session(key)
+        c.ingest(key, first)
+    sess = cl.migrate(key, 1)
+    assert cl.shard_of(key) == 1
+    assert sess.elements == 30
+    # the stream continues across the move; the oracle never migrated
+    for c in (cl, orc):
+        c.ingest(key, second)
+    got, want = cl.snapshot(key), orc.snapshot(key)
+    assert np.array_equal(got, want), (got, want)
+    # served by dst only: src no longer holds the lease
+    assert key in cl.unit(1).service.table
+    assert key not in cl.unit(0).service.table
+    with pytest.raises(UnknownSessionError):
+        cl.unit(0).service.snapshot(key)
+    # front-end bookkeeping carried across the move
+    assert cl.unit(1).service.table.route(key).elements == 60
+    # cross-shard merges follow the override, and the device collective
+    # agrees with the host tree over the migrated row
+    cl.open_session("other")
+    cl.ingest("other", np.arange(40, dtype=np.int32))
+    mh = cl.merged_snapshot([key, "other"], merge_key=3)
+    md = cl.merged_snapshot([key, "other"], merge_key=3, device="xla")
+    assert np.array_equal(mh, np.asarray(md))
+    cl.shutdown()
+    orc.shutdown()
+
+
+def test_recover_replays_migration_bit_exactly(tmp_path):
+    devs = jax.local_devices()
+    cl_dir = str(tmp_path / "cl")
+    cl = ShardedReservoirService(
+        _cfg(), 2, cl_dir, key=7, standby=False,
+        devices=[devs[0], devs[-1]],
+    )
+    key = _key_for_shard(cl, 0, prefix="m")
+    cl.open_session(key)
+    cl.ingest(key, (1000 + np.arange(30)).astype(np.int32))
+    cl.migrate(key, 1)
+    cl.ingest(key, (5000 + np.arange(30)).astype(np.int32))
+    cl.sync()
+    pre = cl.snapshot(key)
+    cl.shutdown()  # kill: recovery must replay the migrate record
+    rec = ShardedReservoirService.recover(
+        cl_dir, standby=False, devices=[devs[0], devs[-1]]
+    )
+    assert rec.shard_of(key) == 1
+    assert key in rec.unit(1).service.table
+    # the migrate record restores the at-migration watermark (the session
+    # journal never carries elements; plain recovered sessions restart at
+    # 0 — the watermark is strictly better, and exact for the move itself)
+    assert rec.unit(1).service.table.route(key).elements == 30
+    assert np.array_equal(pre, rec.snapshot(key))
+    with pytest.raises(UnknownSessionError):
+        rec.unit(0).service.snapshot(key)
+    rec.shutdown()
+
+
+def test_close_reopen_after_migrate_lands_on_dst_and_recovers(tmp_path):
+    cl_dir = str(tmp_path / "cl")
+    cl = ShardedReservoirService(_cfg(), 2, cl_dir, key=9, standby=False)
+    key = _key_for_shard(cl, 0, prefix="z")
+    cl.open_session(key)
+    cl.ingest(key, np.arange(25, dtype=np.int32))
+    cl.migrate(key, 1)
+    cl.close_session(key)
+    # the override outlives the lease: a reopen lands on dst and journals
+    # a route record recovery cross-checks against the override
+    cl.open_session(key)
+    assert key in cl.unit(1).service.table
+    cl.ingest(key, np.arange(10, dtype=np.int32))
+    cl.sync()
+    pre = cl.snapshot(key)
+    cl.shutdown()
+    rec = ShardedReservoirService.recover(cl_dir, standby=False)
+    assert rec.shard_of(key) == 1
+    assert np.array_equal(pre, rec.snapshot(key))
+    rec.shutdown()
+
+
+def test_standby_tails_adopt_frame_and_promotes_bit_exactly(tmp_path):
+    cl = ShardedReservoirService(
+        _cfg(), 2, str(tmp_path / "cl"), key=5, standby=True
+    )
+    key = _key_for_shard(cl, 0, prefix="s")
+    cl.open_session(key)
+    cl.ingest(key, (100 + np.arange(40)).astype(np.int32))
+    cl.migrate(key, 1)
+    cl.ingest(key, (900 + np.arange(40)).astype(np.int32))
+    cl.sync()
+    want = cl.snapshot(key)
+    cl.poll()  # the standby tails the journal, incl. the RTJA adopt frame
+    cl.kill_shard(1)
+    cl.promote_shard(1, reason="migrate-test")
+    assert np.array_equal(want, cl.snapshot(key))
+    cl.shutdown()
+
+
+def test_migrate_validation_surface(tmp_path):
+    cl = ShardedReservoirService(
+        _cfg(), 3, str(tmp_path / "cl"), key=1, standby=False
+    )
+    key = _key_for_shard(cl, 0)
+    cl.open_session(key)
+    cl.ingest(key, np.arange(8, dtype=np.int32))
+    with pytest.raises(ValueError, match="out of range"):
+        cl.migrate(key, 3)
+    with pytest.raises(ValueError, match="already lives"):
+        cl.migrate(key, 0)
+    missing = "never-opened"
+    with pytest.raises(UnknownSessionError):
+        cl.migrate(missing, (cl.shard_of(missing) + 1) % 3)
+    cl.kill_shard(2)
+    with pytest.raises(ShardUnavailable):
+        cl.migrate(key, 2)
+    # the failed attempts left no override and no journal damage: the
+    # session still serves from its hash home
+    assert cl.shard_of(key) == 0
+    assert cl.snapshot(key).size > 0
+    cl.shutdown()
+
+
+# ----------------------------------------------------------- placement
+
+
+def test_spread_devices_round_robins_local_devices():
+    devs = jax.local_devices()
+    got = spread_devices(len(devs) + 2)
+    assert got[: len(devs)] == devs
+    assert got[len(devs)] == devs[0] and got[len(devs) + 1] == devs[1 % len(devs)]
+    with pytest.raises(ValueError, match=">= 1"):
+        spread_devices(0)
+
+
+def test_cluster_devices_spread_and_explicit_placement(tmp_path):
+    devs = jax.local_devices()
+    cl = ShardedReservoirService(
+        _cfg(), 2, str(tmp_path / "cl"), key=3, standby=False,
+        devices="spread",
+    )
+    assert [u.service.device for u in cl.units] == devs[:2]
+    key = _key_for_shard(cl, 0)
+    cl.open_session(key)
+    cl.ingest(key, np.arange(16, dtype=np.int32))
+    snap = cl.snapshot(key)
+    assert snap.size > 0
+    cl.shutdown()
+    with pytest.raises(ValueError, match="devices"):
+        ShardedReservoirService(
+            _cfg(), 2, str(tmp_path / "bad"), standby=False,
+            devices=[devs[0]],  # wrong length
+        )
+    with pytest.raises(ValueError, match="devices"):
+        ShardedReservoirService(
+            _cfg(), 2, str(tmp_path / "bad2"), standby=False,
+            devices="bogus",
+        )

@@ -194,7 +194,7 @@ def test_sweep_is_kernel_parameterized():
     from reservoir_tpu.ops.prefix import CUMSUM_BLOCK
 
     assert set(tpu_block_sweep.SWEEP_SHAPES) == {
-        "algl", "weighted", "distinct"
+        "algl", "weighted", "distinct", "gate"
     }
     assert set(tpu_block_sweep.DEFAULT_VARIANTS) == set(
         tpu_block_sweep.SWEEP_SHAPES
@@ -595,7 +595,8 @@ def test_post_step_rehearsal_sequential_gating(tmp_path, monkeypatch):
     assert [s[0] for s in remaining] == [
         "distinct_sweep", "pallas_device_tests", "algl_best_block",
         "serve_soak", "ha_rehearsal", "gated_sweep", "gated_rehearsal",
-        "shard_rehearsal", "postmortem_rehearsal", "recovery_rehearsal",
+        "shard_rehearsal", "postmortem_rehearsal", "gate_sweep",
+        "merge_sweep", "migrate_rehearsal", "recovery_rehearsal",
     ]
     assert committed == ["3 post-step(s) recorded"]
     rows = [

@@ -51,6 +51,7 @@ PUBLIC_MODULES = [
     "reservoir_tpu.ops.distinct",
     "reservoir_tpu.ops.distinct_pallas",
     "reservoir_tpu.ops.hashing",
+    "reservoir_tpu.ops.merge_pallas",
     "reservoir_tpu.ops.rng",
     "reservoir_tpu.ops.threefry",
     "reservoir_tpu.ops.u64e",

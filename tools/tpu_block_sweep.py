@@ -7,9 +7,11 @@ r7 for weighted/distinct) — so each variant is a full
 ``(block_r, chunk_b, gather_chunk)`` geometry: ``chunk_b`` the
 batch-streaming chunk of the grid pipeline (0 = whole tile, the
 single-chunk shape) and ``gather_chunk`` the one-hot select window
-(algl only; 0 = full-width).  ``--kernel`` selects which Pallas path the
-sweep measures (``algl`` | ``weighted`` | ``distinct``) at that kernel's
-headline bench shape.  This script measures, per variant, compile wall
+(algl only; 0 = full-width).  ``--kernel`` selects which path the
+sweep measures (``algl`` | ``weighted`` | ``distinct`` | ``gate``) at
+that kernel's headline bench shape; ``gate`` sweeps the host-side skip
+gate's ``gate_tile:gate_push_chunk`` pair (the ISSUE-12 satellite —
+pass ``gate_tile=0`` to the bridge/service to consume the winner).  This script measures, per variant, compile wall
 time and steady-state throughput — each in a THROWAWAY subprocess with a
 hard timeout, so a compile blowup costs its timeout and is recorded, never
 inherited.  Appends JSON lines to ``TPU_BLOCK_SWEEP.jsonl`` AND records
@@ -36,11 +38,14 @@ import time
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 OUT = os.path.join(REPO, "TPU_BLOCK_SWEEP.jsonl")
 # sweep shapes = each kernel's headline bench config (BASELINE.md /
-# bench.py defaults): (R, k, B, steps)
+# bench.py defaults): (R, k, B, steps).  "gate" is the host-side skip
+# gate (bench.py's gated A/B shape): its two knobs ride the block_r /
+# chunk_b variant slots as gate_tile / gate_push_chunk.
 SWEEP_SHAPES = {
     "algl": (65536, 128, 2048, 50),
     "weighted": (16384, 64, 1024, 50),
     "distinct": (4096, 256, 1024, 50),
+    "gate": (64, 16, 4096, 40),
 }
 # Per-kernel default variant lists: the proven default first, then the
 # grid-pipeline chunks, then the open block questions.  algl keeps its
@@ -50,6 +55,12 @@ DEFAULT_VARIANTS = {
     "algl": "64:0:512,64:1024:512,64:512:512,64:256:512,128:1024:512",
     "weighted": "128:0:0,128:512:0,128:256:0,128:128:0,64:256:0",
     "distinct": "128:0:0,128:512:0,128:256:0,128:128:0,64:256:0",
+    # gate variants are gate_tile:gate_push_chunk — the default (64, 1Mi)
+    # first, then the tile axis, then the push-slice axis
+    "gate": (
+        "64:1048576,32:1048576,128:1048576,256:1048576,"
+        "64:262144,64:4194304"
+    ),
 }
 # compile-sanity bound for cache admission: a variant that took longer
 # than this to compile+first-run is recorded in the JSONL but never
@@ -67,7 +78,53 @@ SHAPES = {
     "weighted": (16384, 64, 1024, 50),
     "distinct": (4096, 256, 1024, 50),
 }
+SHAPES["gate"] = (64, 16, 4096, 40)
 R, k, B, steps = SHAPES[kernel]
+
+if kernel == "gate":
+    # host-side skip gate: block_r/chunk_b slots carry gate_tile and
+    # gate_push_chunk; the measure is the gated bridge's EFFECTIVE
+    # throughput over per-row bulk pushes (bench.py's gated-side feed)
+    from reservoir_tpu import SamplerConfig
+    from reservoir_tpu.stream.bridge import DeviceStreamBridge
+
+    cfg = SamplerConfig(max_sample_size=k, num_reservoirs=R, tile_size=B)
+    rng = np.random.default_rng(0)
+    data = (
+        rng.integers(0, 1 << 30, (R, B * steps), dtype=np.int64)
+        .astype(np.int32)
+    )
+    bridge = DeviceStreamBridge(
+        cfg, key=0, reusable=True, gated=True,
+        gate_tile=block_r, gate_push_chunk=chunk_b or (1 << 20),
+    )
+
+    def one_pass():
+        for s in range(R):
+            bridge.push(s, data[s])
+        bridge.flush()
+        bridge.drain_barrier()
+        jax.block_until_ready(bridge.engine._state.count)
+
+    t0 = time.perf_counter()
+    one_pass()
+    compile_s = time.perf_counter() - t0
+    times = []
+    for _ in range(2):
+        t0 = time.perf_counter()
+        one_pass()
+        times.append(time.perf_counter() - t0)
+    print(json.dumps({
+        "kernel": kernel,
+        "block_r": block_r,
+        "chunk_b": chunk_b,
+        "gather_chunk": 0,
+        "compile_plus_first_run_s": round(compile_s, 2),
+        "elem_per_sec": R * B * steps / min(times),
+        "device_kind": jax.devices()[0].device_kind,
+        "R": R, "k": k, "B": B,
+    }))
+    sys.exit(0)
 
 if kernel == "algl":
     from reservoir_tpu.ops import algorithm_l as al
@@ -144,11 +201,14 @@ print(json.dumps({
 """
 
 
-def _parse_variant(variant: str) -> "tuple[int, int, int]":
+def _parse_variant(variant: str, kernel: str = "algl") -> "tuple[int, int, int]":
     """``block[:chunk[:gather]]`` -> (block_r, chunk_b, gather_chunk).
     Two-part legacy form ``block:gather`` (pre-r6 algl sweeps had no
-    streaming chunk) maps to chunk_b=0."""
+    streaming chunk) maps to chunk_b=0.  For ``kernel="gate"`` the form
+    is ``gate_tile[:gate_push_chunk]`` riding the first two slots."""
     parts = [int(p) for p in variant.split(":")]
+    if kernel == "gate":
+        return parts[0], parts[1] if len(parts) > 1 else 0, 0
     if len(parts) == 1:
         return parts[0], 0, 512
     if len(parts) == 2:
@@ -179,7 +239,7 @@ def main() -> int:
     from reservoir_tpu.ops import autotune
 
     for variant in variants.split(","):
-        blk, chunk, gather = _parse_variant(variant)
+        blk, chunk, gather = _parse_variant(variant, args.kernel)
         t0 = time.time()
         rec = {
             "ts": datetime.datetime.now(datetime.timezone.utc).isoformat(),
@@ -217,13 +277,22 @@ def main() -> int:
         ):
             # best-rate-wins: the cache ends the sweep holding the fastest
             # sanely-compiling geometry for this kernel+device+shape
+            geom = (
+                autotune.Geometry(
+                    0, 0, 0,
+                    gate_tile=blk,
+                    gate_push_chunk=chunk or (1 << 20),
+                )
+                if args.kernel == "gate"
+                else autotune.Geometry(blk, chunk, gather)
+            )
             rec["cached"] = autotune.record_if_better(
                 res["device_kind"],
                 res.get("R", sweep_r),
                 res.get("k", sweep_k),
                 res.get("B", sweep_b),
                 "int32",
-                autotune.Geometry(blk, chunk, gather),
+                geom,
                 elem_per_sec=res["elem_per_sec"],
                 source="tpu_block_sweep",
                 kernel=args.kernel,

@@ -104,6 +104,11 @@ CONFIG_BUDGETS: dict[str, tuple[float, dict[str, str]]] = {
     # flight recorder live, attribution-vs-wall reconciliation asserted
     # in-run; host-path config, no parity selftest
     "trace": (420.0, {"RESERVOIR_BENCH_SELFTEST": "0"}),
+    # the ISSUE-12 device-vs-host merge A/B + live-migration rehearsal:
+    # on TPU the device path is the Pallas ring collective, bit-identity
+    # vs the host tree asserted in-run; Pallas parity evidence rides the
+    # parity_probe post-step, so no embedded selftest here
+    "merge": (600.0, {"RESERVOIR_BENCH_SELFTEST": "0"}),
 }
 
 # r5 priority order (VERDICT r4): parity-attached headline first, then
@@ -113,7 +118,7 @@ CONFIG_BUDGETS: dict[str, tuple[float, dict[str, str]]] = {
 # a CONFIG_BUDGETS row (an unbudgeted config can burn a whole window).
 DEFAULT_CONFIGS = (
     "algl,algl_chunk1024,algl_chunk0,distinct,weighted,stream,bridge,"
-    "bridge_serial,gated,serve,ha,traffic,shards,trace,algl_B4096"
+    "bridge_serial,gated,serve,ha,traffic,shards,trace,merge,algl_B4096"
 )
 
 def _now() -> str:
@@ -551,6 +556,54 @@ POST_STEPS: list[tuple[str, list[str], float, dict]] = [
             "postmortem or chaos",
         ],
         600.0,
+        {"RESERVOIR_TPU_TEST_PLATFORM": "native"},
+    ),
+    (
+        # gate geometry sweep (ISSUE 12 satellite): tune the skip gate's
+        # (gate_tile, gate_push_chunk) pair into the kernel-keyed autotune
+        # cache on the real backend — the bridge resolves gate_tile=0 from
+        # it at construction, so the next gated run picks the winner up
+        # with no code change
+        "gate_sweep",
+        [
+            sys.executable,
+            os.path.join(REPO, "tools", "tpu_block_sweep.py"),
+            "--kernel",
+            "gate",
+        ],
+        1500.0,
+        {},
+    ),
+    (
+        # merge sweep (ISSUE 12): the device-vs-host merge A/B with the
+        # Pallas ring collective FORCED (the bench's auto mode would pick
+        # it on TPU anyway; forcing makes a silent XLA demotion a recorded
+        # failure instead of a wrong row) — bit-identity vs the host tree
+        # asserted in-run, budget-capped like its siblings
+        "merge_sweep",
+        [sys.executable, os.path.join(REPO, "bench.py")],
+        600.0,
+        {
+            "RESERVOIR_BENCH_CONFIG": "merge",
+            "RESERVOIR_BENCH_MERGE_IMPL": "pallas",
+            "RESERVOIR_BENCH_SELFTEST": "0",
+        },
+    ),
+    (
+        # migration rehearsal (ISSUE 12): the bit-reconciliation matrix —
+        # device-vs-host merge parity across modes/part-counts plus
+        # migrate-mid-stream -> kill -> recover vs the unmigrated oracle —
+        # run against the real backend, budget-capped like its siblings
+        "migrate_rehearsal",
+        [
+            sys.executable,
+            "-m",
+            "pytest",
+            "tests/test_merge_device.py",
+            "-q",
+            "--no-header",
+        ],
+        900.0,
         {"RESERVOIR_TPU_TEST_PLATFORM": "native"},
     ),
     (
