@@ -21,7 +21,15 @@ Schema: version 2 prefixes every key with the kernel name and stamps the
 file with ``"_schema": 2``.  Version-1 files (the algl-only era: bare
 ``device|R=..|..`` keys, no stamp) are migrated silently on load — each
 bare key is read as an ``algl`` entry — and rewritten in the new schema on
-the first :func:`record`.
+the first :func:`record`.  Version 3 (ISSUE 14) adds the ``serve`` entry
+kind — service-knob winners keyed by workload fingerprint
+(:mod:`reservoir_tpu.serve.autotune` owns the key format and entry
+shape) — without touching the kernel-geometry key form at all, so a v2
+file loads unchanged and round-trips losslessly once a serve entry is
+recorded next to its kernel entries.  The generic :func:`lookup_raw` /
+:func:`record_raw` pair is the extension surface: new entry kinds ride
+the same atomic tmp+rename store without teaching this module their
+schema.
 
 File location: ``$RESERVOIR_ALGL_AUTOTUNE_CACHE`` if set, else
 ``TPU_ALGL_AUTOTUNE.json`` at the repo root (committed with the sweep
@@ -42,11 +50,14 @@ import numpy as np
 __all__ = [
     "Geometry",
     "KERNELS",
+    "ENTRY_KINDS",
     "cache_path",
     "make_key",
     "load",
     "lookup",
+    "lookup_raw",
     "record",
+    "record_raw",
     "record_if_better",
 ]
 
@@ -55,12 +66,18 @@ _REPO = os.path.dirname(
 )
 _DEFAULT_CACHE = os.path.join(_REPO, "TPU_ALGL_AUTOTUNE.json")
 
-_SCHEMA = 2
+_SCHEMA = 3
 #: The kernel dimension of the cache key — one entry space per Pallas path,
 #: plus the host-side ``gate`` pseudo-kernel (the skip-ahead gate's
 #: ``gate_tile``/``gate_push_chunk`` pair is a throughput geometry too, and
 #: the sweep measures it the same way).
 KERNELS = ("algl", "weighted", "distinct", "gate")
+#: Every key prefix the store accepts: the kernel geometries plus the
+#: schema-3 ``serve`` knob entries (ISSUE 14 — the serving plane's tuned
+#: knobs live in the same file, same atomic write, same mtime memo; the
+#: serve layer owns their key format and entry shape via
+#: :func:`lookup_raw`/:func:`record_raw`).
+ENTRY_KINDS = KERNELS + ("serve",)
 
 # (path, mtime) -> parsed dict; loads are hot (one per engine jit-cache
 # miss), files are tiny and almost never change mid-process
@@ -112,7 +129,7 @@ def _migrate(data: dict) -> dict:
     for key, v in data.items():
         if key == "_schema" or not isinstance(key, str):
             continue
-        if key.split("|", 1)[0] in KERNELS:
+        if key.split("|", 1)[0] in ENTRY_KINDS:
             out[key] = v
         else:
             out["algl|" + key] = v
@@ -173,40 +190,29 @@ def lookup(
         return None
 
 
-def record(
-    device_kind: str,
-    R: int,
-    k: int,
-    B: int,
-    dtype,
-    geometry: Geometry,
-    elem_per_sec: "float | None" = None,
-    source: "str | None" = None,
-    path: "str | None" = None,
-    *,
-    kernel: str = "algl",
-) -> None:
-    """Write one geometry entry (atomic tmp+rename; merges with the
-    existing file, migrating a v1 file to schema 2 as it does).
-    ``elem_per_sec``/``source`` ride along as provenance —
-    :func:`record_if_better` uses the rate to keep only winners."""
+def lookup_raw(key: str, path: "str | None" = None) -> Optional[dict]:
+    """The raw entry dict under ``key``, or None.  The extension surface
+    for non-geometry entry kinds (``serve|...`` knob winners): the caller
+    owns the key format and the entry shape; this module only guarantees
+    the atomic store and the mtime-memoized load."""
+    entry = load(path).get(key)
+    return entry if isinstance(entry, dict) else None
+
+
+def record_raw(key: str, entry: dict, path: "str | None" = None) -> None:
+    """Write one raw entry (atomic tmp+rename; merges with the existing
+    file, migrating it to the current schema as it does).  The key's
+    prefix must be a registered entry kind — anything else would be
+    rewritten as an ``algl`` key by the v1 migration on the next load."""
+    kind = key.split("|", 1)[0]
+    if kind not in ENTRY_KINDS:
+        raise ValueError(
+            f"unknown entry kind {kind!r}: key prefix must be one of "
+            f"{ENTRY_KINDS}"
+        )
     path = path or cache_path()
     data = dict(load(path))
-    entry = {
-        "block_r": int(geometry.block_r),
-        "chunk_b": int(geometry.chunk_b),
-        "gather_chunk": int(geometry.gather_chunk),
-    }
-    # gate fields only when set — non-gate entries keep their exact shape
-    if geometry.gate_tile:
-        entry["gate_tile"] = int(geometry.gate_tile)
-    if geometry.gate_push_chunk:
-        entry["gate_push_chunk"] = int(geometry.gate_push_chunk)
-    if elem_per_sec is not None:
-        entry["elem_per_sec"] = float(elem_per_sec)
-    if source is not None:
-        entry["source"] = source
-    data[make_key(device_kind, R, k, B, dtype, kernel=kernel)] = entry
+    data[key] = entry
     data["_schema"] = _SCHEMA
     d = os.path.dirname(os.path.abspath(path)) or "."
     fd, tmp = tempfile.mkstemp(prefix=".autotune.", dir=d)
@@ -222,6 +228,42 @@ def record(
             pass
         raise
     _LOAD_MEMO.pop(path, None)
+
+
+def record(
+    device_kind: str,
+    R: int,
+    k: int,
+    B: int,
+    dtype,
+    geometry: Geometry,
+    elem_per_sec: "float | None" = None,
+    source: "str | None" = None,
+    path: "str | None" = None,
+    *,
+    kernel: str = "algl",
+) -> None:
+    """Write one geometry entry (atomic tmp+rename; merges with the
+    existing file, migrating a v1 file to the current schema as it does).
+    ``elem_per_sec``/``source`` ride along as provenance —
+    :func:`record_if_better` uses the rate to keep only winners."""
+    entry = {
+        "block_r": int(geometry.block_r),
+        "chunk_b": int(geometry.chunk_b),
+        "gather_chunk": int(geometry.gather_chunk),
+    }
+    # gate fields only when set — non-gate entries keep their exact shape
+    if geometry.gate_tile:
+        entry["gate_tile"] = int(geometry.gate_tile)
+    if geometry.gate_push_chunk:
+        entry["gate_push_chunk"] = int(geometry.gate_push_chunk)
+    if elem_per_sec is not None:
+        entry["elem_per_sec"] = float(elem_per_sec)
+    if source is not None:
+        entry["source"] = source
+    record_raw(
+        make_key(device_kind, R, k, B, dtype, kernel=kernel), entry, path
+    )
 
 
 def record_if_better(

@@ -17,6 +17,13 @@ one-shot reads.  The serve layer adds the missing multiplexing plane:
   reject-with-retry-after), live non-destructive snapshot queries served
   from a ``flushed_seq``-keyed device->host cache, and crash recovery that
   rebuilds the session table from a journaled session map;
+- :mod:`.autotune` — the SLO-closed-loop knob plane (ISSUE 14): a
+  workload-fingerprinted persistent cache of swept service-knob winners
+  (same atomic JSON store as the kernel-geometry autotuner; consumed at
+  construction, explicit kwargs winning) plus a
+  :class:`~reservoir_tpu.serve.autotune.ServiceTuner` that nudges the
+  live knobs inside declared safe bounds with AIMD hysteresis, driven by
+  the :class:`~reservoir_tpu.obs.slo.SLOPlane` burn verdicts;
 - :mod:`.replica` / :mod:`.ha` — the high-availability plane (ISSUE 5): a
   :class:`~reservoir_tpu.serve.replica.StandbyReplica` tails the primary's
   flush journal into a warm, bit-identical replica
@@ -40,6 +47,15 @@ one-shot reads.  The serve layer adds the missing multiplexing plane:
   (:func:`~reservoir_tpu.parallel.merge.merge_samples_host`).
 """
 
+from .autotune import (
+    DEFAULT_KNOBS,
+    KnobBounds,
+    ServiceKnobs,
+    ServiceTuner,
+    TuneDecision,
+    lookup_knobs,
+    record_knobs,
+)
 from .cluster import ShardedReservoirService, shard_of
 from .ha import FailoverController, HealthReport, HeartbeatWriter, read_heartbeat
 from .replica import JournalFollower, StandbyReplica
@@ -49,6 +65,13 @@ from .shard import ShardUnit
 
 __all__ = [
     "ReservoirService",
+    "ServiceKnobs",
+    "ServiceTuner",
+    "TuneDecision",
+    "KnobBounds",
+    "DEFAULT_KNOBS",
+    "lookup_knobs",
+    "record_knobs",
     "Session",
     "SessionTable",
     "ShardUnit",

@@ -65,12 +65,26 @@ from ..errors import (
 from ..stream.bridge import DeviceStreamBridge
 from ..utils import faults as _faults
 from ..utils.metrics import ServiceMetrics
+from . import autotune as _serve_tune
+from .autotune import DEFAULT_KNOBS, ServiceKnobs
 from .sessions import Session, SessionTable
 
 __all__ = ["ReservoirService"]
 
 _JOURNAL_NAME = "sessions.jsonl"
 _JOURNAL_VERSION = 1
+
+class _Unset:
+    """Distinct from ``None``: ``sweep_interval_s=None`` is a meaningful
+    setting (manual sweeps only), so "not passed — resolve from the knob
+    cache" needs its own sentinel.  The stable repr keeps generated API
+    manifests deterministic across processes."""
+
+    def __repr__(self) -> str:
+        return "<UNSET>"
+
+
+_UNSET: Any = _Unset()
 
 
 def _read_session_journal(path: str) -> Tuple[dict, List[dict]]:
@@ -113,6 +127,13 @@ class ReservoirService:
         rows draw from ``fold_in(fold_in(key(session_seed), row), gen)``).
       coalesce_bytes: pending-ingest threshold at which the buffer ships
         through ``push_interleaved`` (cross-session batching lever).
+        Like every serving knob below (``max_inflight_bytes`` /
+        ``checkpoint_every`` / ``sweep_interval_s`` / ``gate_push_chunk``),
+        leaving it unset consumes the swept winner from the persistent
+        knob cache (:mod:`reservoir_tpu.serve.autotune`, ISSUE 14) for
+        this service's workload fingerprint — exactly the way the engine
+        consumes tuned kernel geometry.  An explicit value always wins;
+        no cache entry = the builtin default, byte-identical behavior.
       max_inflight_bytes: admission-control budget over pending bytes;
         beyond it, ingest either flushes (pipeline willing) or rejects
         with :class:`ServiceSaturated`.
@@ -166,25 +187,60 @@ class ReservoirService:
         *,
         ttl_s: Optional[float] = None,
         session_seed: int = 0,
-        coalesce_bytes: int = 1 << 16,
-        max_inflight_bytes: int = 1 << 24,
+        coalesce_bytes: Optional[int] = None,
+        max_inflight_bytes: Optional[int] = None,
         retry_after_s: float = 0.05,
-        sweep_interval_s: Optional[float] = None,
+        sweep_interval_s: Optional[float] = _UNSET,
         auditor: Optional[Any] = None,
         obs_scope: Optional[str] = None,
         pipelined: bool = True,
         retry_policy: Optional[RetryPolicy] = None,
         flush_timeout_s: Optional[float] = None,
         checkpoint_dir: Optional[str] = None,
-        checkpoint_every: int = 64,
+        checkpoint_every: Optional[int] = None,
         durability: str = "buffered",
         faults: Optional[Any] = None,
         gated: bool = False,
         gate_tile: int = 64,
+        gate_push_chunk: Optional[int] = None,
         device: Optional[Any] = None,
         _bridge: Optional[DeviceStreamBridge] = None,
         _table: Optional[SessionTable] = None,
     ) -> None:
+        # knob-cache consumption (ISSUE 14): any knob left unset resolves
+        # to the swept winner for this workload fingerprint, then to the
+        # builtin default — the engine's kernel-geometry discipline,
+        # applied to the serving plane.  Explicit kwargs always win.
+        if (
+            coalesce_bytes is None
+            or max_inflight_bytes is None
+            or checkpoint_every is None
+            or gate_push_chunk is None
+            or sweep_interval_s is _UNSET
+        ):
+            mode = (
+                "weighted"
+                if config.weighted
+                else "distinct" if config.distinct else "plain"
+            )
+            tuned = _serve_tune.lookup_knobs(
+                _serve_tune.device_kind_of(device),
+                int(config.num_reservoirs),
+                int(config.max_sample_size),
+                mode,
+                bool(gated),
+            ) or DEFAULT_KNOBS
+            if coalesce_bytes is None:
+                coalesce_bytes = tuned.coalesce_bytes
+            if max_inflight_bytes is None:
+                max_inflight_bytes = tuned.max_inflight_bytes
+            if checkpoint_every is None:
+                checkpoint_every = tuned.checkpoint_every
+            if gate_push_chunk is None:
+                gate_push_chunk = tuned.gate_push_chunk
+            if sweep_interval_s is _UNSET:
+                # cache 0.0 = manual-only, the constructor's None
+                sweep_interval_s = tuned.sweep_interval_s or None
         if coalesce_bytes <= 0 or max_inflight_bytes <= 0:
             raise ValueError(
                 "coalesce_bytes and max_inflight_bytes must be positive"
@@ -208,6 +264,9 @@ class ReservoirService:
             faults=faults,
             gated=gated,
             gate_tile=gate_tile,
+            # cache 0 = "no opinion": keep the bridge's builtin default
+            # rather than triggering its gate-geometry resolution
+            gate_push_chunk=int(gate_push_chunk) if gate_push_chunk else 1 << 20,
             device=device,
         )
         config = self._bridge._config
@@ -225,6 +284,7 @@ class ReservoirService:
         self._auditor = auditor
         self._obs_scope = obs_scope
         self._last_sweep = self._table._clock()
+        self._tuner = None  # ServiceTuner attaches itself (ISSUE 14)
         self._metrics = ServiceMetrics()
         self._metrics.sessions_open = len(self._table)
         # pending cross-session coalesce buffer: (rows, elems, weights)
@@ -289,6 +349,54 @@ class ReservoirService:
         """The device this service's engine is pinned to (``None`` when
         unpinned)."""
         return self._bridge.device
+
+    # ---------------------------------------------------------- live knobs
+
+    def live_knobs(self) -> ServiceKnobs:
+        """The serving knobs as currently live (constructor-resolved plus
+        any :meth:`apply_knobs` nudges since) — what the
+        :class:`~reservoir_tpu.serve.autotune.ServiceTuner` reads before
+        every control step and the sweep tool scores."""
+        return ServiceKnobs(
+            coalesce_bytes=self._coalesce_bytes,
+            max_inflight_bytes=self._max_inflight_bytes,
+            checkpoint_every=self._bridge.checkpoint_every,
+            sweep_interval_s=self._sweep_interval_s or 0.0,
+            gate_push_chunk=self._bridge.gate_push_chunk,
+        )
+
+    def apply_knobs(self, knobs: ServiceKnobs) -> None:
+        """Apply a knob vector to the LIVE service (the online controller's
+        write path).  Validates the same invariants as construction; takes
+        effect from the next ingest/flush — never retroactively, so a
+        nudge can change when bytes ship or state checkpoints, but no
+        accepted element is ever dropped or resampled."""
+        knobs = ServiceKnobs(*knobs)
+        if knobs.coalesce_bytes <= 0 or knobs.max_inflight_bytes <= 0:
+            raise ValueError(
+                "coalesce_bytes and max_inflight_bytes must be positive"
+            )
+        if knobs.coalesce_bytes > knobs.max_inflight_bytes:
+            raise ValueError(
+                "coalesce_bytes must not exceed max_inflight_bytes"
+            )
+        self._coalesce_bytes = int(knobs.coalesce_bytes)
+        self._max_inflight_bytes = int(knobs.max_inflight_bytes)
+        self._bridge.set_checkpoint_every(knobs.checkpoint_every)
+        if knobs.gate_push_chunk:
+            self._bridge.set_gate_push_chunk(knobs.gate_push_chunk)
+        self._sweep_interval_s = (
+            float(knobs.sweep_interval_s)
+            if knobs.sweep_interval_s > 0
+            else None
+        )
+
+    def attach_tuner(self, tuner: Optional[Any]) -> None:
+        """Attach (or detach, with ``None``) the online knob controller:
+        every accepted ingest then gives it a rate-limited
+        ``maybe_observe`` tick.  With no tuner attached the hot path pays
+        one ``None`` test — the trip-wire-pinned zero-overhead bar."""
+        self._tuner = tuner
 
     def _scoped(self, name: str) -> str:
         """Instrument name under this service's per-shard scope (ISSUE 9);
@@ -491,6 +599,10 @@ class ReservoirService:
             reg.histogram(self._scoped("serve.ingest_s")).observe(
                 time.perf_counter() - t0
             )
+        if self._tuner is not None:
+            # closed loop (ISSUE 14): rate-limited inside, so steady
+            # traffic drives SLO evaluation without a background thread
+            self._tuner.maybe_observe()
         return n
 
     def _ingest_impl(
@@ -752,10 +864,10 @@ class ReservoirService:
         checkpoint_dir: str,
         *,
         ttl_s: Optional[float] = None,
-        coalesce_bytes: int = 1 << 16,
-        max_inflight_bytes: int = 1 << 24,
+        coalesce_bytes: Optional[int] = None,
+        max_inflight_bytes: Optional[int] = None,
         retry_after_s: float = 0.05,
-        sweep_interval_s: Optional[float] = None,
+        sweep_interval_s: Optional[float] = _UNSET,
         auditor: Optional[Any] = None,
         obs_scope: Optional[str] = None,
         pipelined: Optional[bool] = None,

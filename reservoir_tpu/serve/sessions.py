@@ -19,6 +19,12 @@ Design points:
   (:meth:`sweep`), and :meth:`open` on a full table evicts the
   least-recently-used session (long-lived queryable handles in the style
   of *StreamSampling.jl*, arXiv:2603.21996, must not leak rows forever).
+  Sweep cost is O(expired·log n), not O(n): every touch pushes an
+  ``(expiry, seq, key)`` entry onto a lazy-deletion heap, and stale
+  entries (the session was touched again, closed, or evicted since the
+  push) are skipped on pop — the amortized-constant batching discipline
+  of Sanders et al., arXiv:1610.05141, applied to TTL eviction so a
+  million-session table never pays a full scan per sweep.
 - **counter-keyed sub-seeds**: :meth:`sub_key` derives a per-lease Threefry
   key by folding ``(row, generation)`` into a table-level base key — the
   engine is never reseeded, yet every re-lease of a row gets a
@@ -28,6 +34,7 @@ Design points:
 
 from __future__ import annotations
 
+import heapq
 import time
 from collections import OrderedDict, deque
 from typing import Callable, List, Optional, Tuple
@@ -97,6 +104,12 @@ class SessionTable:
         # insertion order == recency order (route() moves to end): the
         # front is always the LRU eviction candidate
         self._sessions: "OrderedDict[str, Session]" = OrderedDict()
+        # lazy-deletion expiry heap: (last_used + ttl, push_seq, key).  A
+        # touch pushes a fresh entry and orphans the old one; sweep skips
+        # entries whose expiry no longer matches the session's live
+        # last_used + ttl.  Bounded by periodic compaction (_maybe_compact)
+        self._expiry: List[Tuple[float, int, str]] = []
+        self._eseq = 0
         self._base_key = None  # jax key, built lazily (host-only until then)
 
     # ------------------------------------------------------------ introspection
@@ -155,6 +168,7 @@ class SessionTable:
         row = self._free.popleft()
         sess = Session(key, row, self._gen[row], now)
         self._sessions[key] = sess
+        self._push_expiry(sess)
         return sess, evicted
 
     def route(self, key: str, now: Optional[float] = None) -> Session:
@@ -173,6 +187,7 @@ class SessionTable:
             )
         sess.last_used = self._clock() if now is None else now
         self._sessions.move_to_end(key)
+        self._push_expiry(sess)
         return sess
 
     def check(self, sess: Session) -> None:
@@ -198,21 +213,54 @@ class SessionTable:
 
     def sweep(self, now: Optional[float] = None) -> List[Session]:
         """Evict every TTL-expired session; returns them (empty when TTL is
-        disabled).  The service journals each eviction."""
+        disabled).  The service journals each eviction.
+
+        O(expired·log n): pops the expiry heap while its head is past
+        ``now``, skipping entries orphaned by a later touch/close (the
+        session's live ``last_used + ttl`` no longer matches the popped
+        expiry).  Eviction order is expiry order, which for a
+        recency-refreshed heap equals LRU order — the same order the old
+        full-scan produced."""
         if self._ttl is None:
             return []
         now = self._clock() if now is None else now
-        expired = [
-            s for s in self._sessions.values()
-            if now - s.last_used > self._ttl
-        ]
-        return [self._remove(s.key) for s in expired]
+        heap, ttl = self._expiry, self._ttl
+        evicted: List[Session] = []
+        while heap and heap[0][0] < now:
+            expiry, _, key = heapq.heappop(heap)
+            sess = self._sessions.get(key)
+            # exact-float match: the live entry for this session is the one
+            # pushed with its current last_used; any earlier push is stale
+            if sess is not None and sess.last_used + ttl == expiry:
+                evicted.append(self._remove(key))
+        return evicted
 
     def _remove(self, key: str) -> Session:
         sess = self._sessions.pop(key)
         self._gen[sess.row] += 1  # stale handles can never read this row
         self._free.append(sess.row)
         return sess
+
+    def _push_expiry(self, sess: Session) -> None:
+        """Push this session's current expiry onto the lazy-deletion heap
+        (no-op when TTL is disabled).  Earlier entries for the same key
+        become orphans that sweep skips on pop; compaction keeps the heap
+        from growing unboundedly under touch-heavy traffic."""
+        if self._ttl is None:
+            return
+        self._eseq += 1
+        heapq.heappush(
+            self._expiry, (sess.last_used + self._ttl, self._eseq, sess.key)
+        )
+        # amortized O(1): rebuild from live sessions once orphans dominate
+        if len(self._expiry) > max(1024, 8 * len(self._sessions)):
+            ttl = self._ttl
+            self._expiry = [
+                (s.last_used + ttl, i, s.key)
+                for i, s in enumerate(self._sessions.values())
+            ]
+            heapq.heapify(self._expiry)
+            self._eseq = len(self._expiry)
 
     # ---------------------------------------------------------------- sub-keys
 

@@ -895,6 +895,31 @@ class DeviceStreamBridge:
         return self._gate_reason
 
     @property
+    def checkpoint_every(self) -> int:
+        """Live auto-checkpoint cadence in flushes (see
+        :meth:`set_checkpoint_every`)."""
+        return self._ckpt_every
+
+    @property
+    def gate_push_chunk(self) -> int:
+        """Live slice width of the gated push fast path (see
+        :meth:`set_gate_push_chunk`)."""
+        return self._gate_push_chunk
+
+    def set_checkpoint_every(self, n: int) -> None:
+        """Retune the auto-checkpoint cadence on a LIVE bridge (the serve
+        autotuner's write path, ISSUE 14).  Takes effect from the next
+        flush; durability is unaffected — every flush is journaled
+        regardless, the cadence only sets how far recovery replays."""
+        self._ckpt_every = max(1, int(n))
+
+    def set_gate_push_chunk(self, n: int) -> None:
+        """Retune the gated push slice width on a LIVE bridge (ISSUE 14).
+        Takes effect from the next push; a no-op path on ungated bridges
+        (the field exists either way so live setters work on any bridge)."""
+        self._gate_push_chunk = max(1, int(n))
+
+    @property
     def is_open(self) -> bool:
         return self._engine.is_open and not self._future.done()
 

@@ -146,7 +146,7 @@ class TestSchemaMigration:
             autotune.Geometry(128, 256, 0), kernel="distinct",
         )
         raw = json.load(open(cache))
-        assert raw["_schema"] == 2
+        assert raw["_schema"] == autotune._SCHEMA
         assert v1_key not in raw  # rewritten under the kernel-keyed form
         assert "algl|" + v1_key in raw
         # both the migrated and the new entry survive the rewrite
@@ -163,10 +163,52 @@ class TestSchemaMigration:
             kernel="weighted",
         )
         raw = json.load(open(cache))
-        assert raw["_schema"] == 2
+        assert raw["_schema"] == autotune._SCHEMA
         assert autotune.lookup(
             "cpu", 8, 4, 16, "int32", kernel="weighted"
         ) == autotune.Geometry(8, 8, 0)
+
+    def test_v2_kernel_entries_survive_serve_entry(self, cache):
+        # the ISSUE-14 migration pin: a v2 kernel-geometry file loads
+        # unchanged under schema 3, and recording a serve-knob entry next
+        # to its kernel entries round-trips them losslessly — same keys,
+        # same entry dicts, byte-equal modulo the stamp + the new entry
+        v2 = {
+            "_schema": 2,
+            "algl|tpu v5e|R=65536|k=128|B=2048|int32": {
+                "block_r": 64, "chunk_b": 1024, "gather_chunk": 512,
+                "elem_per_sec": 2e10,
+            },
+            "gate|tpu v5e|R=65536|k=128|B=2048|int32": {
+                "block_r": 0, "chunk_b": 0, "gather_chunk": 0,
+                "gate_tile": 128, "gate_push_chunk": 1 << 18,
+            },
+        }
+        with open(cache, "w") as f:
+            json.dump(v2, f)
+        kernel_entries = {k: v for k, v in v2.items() if k != "_schema"}
+        # v2 keys pass the migration untouched (no re-prefixing)
+        assert autotune.load(cache) == kernel_entries
+        serve_key = "serve|tpu v5e|R=65536|k=128|mode=plain|gated=1|rate=1e3|zipf=1.0"
+        autotune.record_raw(
+            serve_key, {"coalesce_bytes": 1 << 17}, cache
+        )
+        raw = json.load(open(cache))
+        assert raw["_schema"] == autotune._SCHEMA == 3
+        # lossless round-trip of every v2 kernel entry
+        for key, entry in kernel_entries.items():
+            assert raw[key] == entry
+        assert raw[serve_key] == {"coalesce_bytes": 1 << 17}
+        assert autotune.lookup(
+            "tpu v5e", 65536, 128, 2048, "int32", kernel="algl"
+        ) == autotune.Geometry(64, 1024, 512)
+        assert autotune.lookup_raw(serve_key, cache) == {
+            "coalesce_bytes": 1 << 17
+        }
+        # the raw writer refuses unregistered entry kinds — a typo'd
+        # prefix would be silently rewritten as algl on the next load
+        with pytest.raises(ValueError):
+            autotune.record_raw("blorp|x", {}, cache)
 
 
 class TestEngineConsumption:

@@ -238,6 +238,63 @@ def test_bench_trace_row_reports_attribution_reconciliation():
     assert "serve.admission" in stages["stage_share"]
 
 
+def test_bench_tune_row_reports_ab_and_cycle():
+    # the ISSUE-14 acceptance surface: `bench.py tune` must sweep knobs
+    # into a temp cache, assert IN-RUN that construction consumed the
+    # recorded winner, that autotuned throughput holds against the
+    # defaults on one schedule with every SLO ok, and that the online
+    # tuner's fault-injected warn-burn cycle backed off within one
+    # window and re-probed on recovery.  One rep: the sweep already runs
+    # a loadgen pass per candidate
+    rec = _run_bench(
+        {"RESERVOIR_BENCH_CONFIG": "tune", "RESERVOIR_BENCH_REPS": "1"}
+    )
+    assert "tune_autotuned_feed" in rec["metric"]
+    assert rec["value"] > 0
+    # the row only exists if the in-run asserts held
+    assert rec["slo_worst"] == "ok"
+    assert rec["tune_gain"] >= 0.9
+    assert rec["backoffs"] >= 1 and rec["probes"] >= 1
+    stages = rec["stages"]
+    for col in (
+        "candidates", "winner_index", "knobs_default", "knobs_tuned",
+        "recorded_keys", "default_elem_s", "tuned_elem_s", "tune_gain",
+        "slo", "slo_worst", "cycle",
+    ):
+        assert col in stages, col
+    assert stages["candidates"] >= 2
+    assert len(stages["recorded_keys"]) == 2  # banded + any/any fallback
+    assert all(key.startswith("serve|") for key in stages["recorded_keys"])
+    cycle = stages["cycle"]
+    assert cycle["coalesce_backed_off"] < cycle["coalesce_optimum"]
+    assert cycle["coalesce_recovered"] > cycle["coalesce_backed_off"]
+
+
+def test_bench_scale_row_reports_sweep_ratio_and_memory():
+    # the ISSUE-14 million-session hot path: `bench.py scale` must
+    # assert IN-RUN that the expiry sweep is sublinear in table size
+    # (fixed expired count, 10x sizes, <= 5x cost) and that the loadgen
+    # stayed under its memory ceiling against a universe far past the
+    # table, and report both on the row.  One rep: the universe run is
+    # the expensive part
+    rec = _run_bench(
+        {"RESERVOIR_BENCH_CONFIG": "scale", "RESERVOIR_BENCH_REPS": "1"}
+    )
+    assert "scale_session_universe" in rec["metric"]
+    assert rec["value"] > 0
+    assert rec["universe"] >= 100_000  # smoke scales the universe down
+    assert rec["sweep_cost_ratio"] <= 5.0
+    assert rec["loadgen_peak_mb"] <= 192.0
+    stages = rec["stages"]
+    for col in (
+        "universe", "capacity", "elements", "sweep_sizes", "sweep_expired",
+        "sweep_cost_ratio", "loadgen_peak_mb", "ingest_p99_ms",
+    ):
+        assert col in stages, col
+    assert stages["universe"] > stages["capacity"]  # eviction was real
+    assert stages["serve"]["evictions"] > 0
+
+
 def test_bench_rejects_unknown_config():
     env = dict(os.environ)
     env.update(RESERVOIR_BENCH_SMOKE="1", RESERVOIR_BENCH_CONFIG="nope")

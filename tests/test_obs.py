@@ -244,6 +244,16 @@ def test_disabled_telemetry_is_zero_overhead_noop(monkeypatch, tmp_path):
     auditor = SampleQualityAuditor()
     for method in ("_record", "_observe", "_check"):
         monkeypatch.setattr(SampleQualityAuditor, method, tripwire)
+    # and the SLO-closed-loop tuner (ISSUE 14): with no tuner attached,
+    # the ingest hook is one `is not None` test — no ServiceTuner method
+    # may ever be entered on the serve hot path
+    from reservoir_tpu.serve.autotune import ServiceTuner
+
+    for method in (
+        "maybe_observe", "observe", "_decide", "_backoff_from",
+        "_probe_from", "_instrument",
+    ):
+        monkeypatch.setattr(ServiceTuner, method, tripwire)
     svc = ReservoirService(_cfg(), auditor=auditor)
     svc.open_session("a")
     svc.ingest("a", np.arange(32, dtype=np.int32))
@@ -601,6 +611,41 @@ def test_standby_status_file_and_lag_instruments(tmp_path):
         assert status["lag_seq"] == 0 and status["promoted"] is False
         assert reg.histogram("replica.apply_s").count >= 1
         assert reg.gauge("replica.lag_seq").value == 0
+        svc.shutdown()
+
+
+def test_reservoir_top_renders_tuner_panel(tmp_path):
+    # the ISSUE-14 panel: once a ServiceTuner decision instruments the
+    # tune.* gauges, the heartbeat's embedded export carries them and
+    # reservoir_top renders a dedicated tuner panel (and keeps tune.*
+    # out of the catch-all gauge/counter lines)
+    from reservoir_tpu.serve import (
+        HeartbeatWriter,
+        ReservoirService,
+        ServiceTuner,
+    )
+
+    with obs.active():
+        ckdir = str(tmp_path / "ck")
+        svc = ReservoirService(
+            _cfg(R=4, B=16),
+            checkpoint_dir=ckdir,
+            checkpoint_every=1 << 30,
+            coalesce_bytes=64,
+        )
+        fake = [0.0]
+        plane = obs.SLOPlane(clock=lambda: fake[0])
+        tuner = ServiceTuner(
+            svc, plane, interval_s=0.0, clock=lambda: fake[0]
+        )
+        tuner.observe()  # one decision: the tune.* gauges land
+        hb = HeartbeatWriter(ckdir, service=svc)
+        hb.beat()
+        frame = reservoir_top.render(reservoir_top.collect(ckdir))
+        assert "tuner: backoffs=0 probes=0" in frame
+        assert "knobs:" in frame and "coalesce_bytes=64" in frame
+        # the panel owns tune.*: the generic gauges line must not repeat
+        assert "tune.coalesce_bytes" not in frame
         svc.shutdown()
 
 

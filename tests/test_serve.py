@@ -175,6 +175,64 @@ def test_table_ttl_sweep_and_pressure_eviction():
     assert [e.key for e in evicted] == ["b"]
 
 
+def test_table_sweep_cost_is_flat_in_table_size():
+    """The expiry-heap sweep (ISSUE 14) pays O(expired * log n), not a
+    scan of every live session: with the SAME fixed expired count, a 10x
+    bigger table must not cost ~10x.  Structurally, a sweep pops exactly
+    the expired heap entries and leaves the rest untouched; the timing
+    bound (generous — a linear scan would pay ~10x) backs that up."""
+    import time as _time
+
+    expired_n = 64
+
+    def build(n):
+        table = SessionTable(n, ttl_s=10.0, clock=lambda: 0.0)
+        for i in range(expired_n):
+            table.open(f"d{i}", now=0.0)  # doomed: expiry at t=10
+        for i in range(n - expired_n):
+            table.open(f"s{i}", now=100.0)  # long-lived bulk
+        return table
+
+    def sweep_cost(n):
+        best = float("inf")
+        for _ in range(5):
+            table = build(n)
+            heap_before = len(table._expiry)
+            t0 = _time.perf_counter()
+            evicted = table.sweep(now=12.0)
+            best = min(best, _time.perf_counter() - t0)
+            assert sorted(s.key for s in evicted) == sorted(
+                f"d{i}" for i in range(expired_n)
+            )
+            # exactly the expired entries popped — nothing else examined
+            assert heap_before - len(table._expiry) == expired_n
+            assert len(table) == n - expired_n
+        return best
+
+    small, large = sweep_cost(10_000), sweep_cost(100_000)
+    assert large <= max(small, 5e-5) * 6.0, (
+        f"sweep cost grew {large / small:.1f}x for a 10x larger table "
+        f"({small * 1e6:.0f}us -> {large * 1e6:.0f}us)"
+    )
+
+
+def test_table_expiry_heap_compacts_under_touch_churn():
+    """Every route() pushes a fresh heap entry and orphans the old one;
+    periodic compaction must keep the heap bounded by a constant factor
+    of the live-session count instead of growing with touch traffic."""
+    clock = _Clock()
+    table = SessionTable(64, ttl_s=10.0, clock=clock)
+    for i in range(64):
+        table.open(f"s{i}")
+    for step in range(2000):
+        clock.t += 0.001
+        table.route(f"s{step % 64}")
+    assert len(table._expiry) <= max(1024, 8 * len(table))
+    # and correctness survives the churn: idle everyone out
+    clock.t += 100.0
+    assert len(table.sweep()) == 64 and len(table) == 0
+
+
 def test_table_sub_key_is_deterministic_and_fresh_per_generation():
     table = SessionTable(4, seed=9)
     k_a = table.sub_key(1, 1)

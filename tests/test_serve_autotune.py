@@ -1,0 +1,463 @@
+"""SLO-closed-loop service autotuner (ISSUE 14).
+
+Four surfaces:
+
+- the **workload-fingerprinted knob cache** (``serve/autotune.py`` over
+  ``ops/autotune.py`` schema 3): banding, round-trip, ``any`` fallback;
+- **construction-time consumption**: a ``ReservoirService`` built with
+  knobs unset resolves the cached winner, explicit kwargs always win,
+  an empty cache means the builtin defaults — byte-for-byte;
+- the **online ServiceTuner** control law under a deterministic fake
+  clock: a warn-level burn (fault-injected ingest latency against a
+  quantile-0.9 SLO, where warn is reachable at bad-frac >= 0.3 and page
+  needs >= 1.44 — impossible) backs every active knob off toward its
+  safe end within ONE window; a healthy dwell re-probes toward the
+  optimum; every nudge clamps into the declared bounds;
+- the **advisory-only guarantee**: a tuner attached at its optimum
+  journals byte-identically to no tuner at all — knob control can change
+  when bytes ship, never what is sampled.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+import pytest
+
+from reservoir_tpu import SamplerConfig, obs
+from reservoir_tpu.ops import autotune as store
+from reservoir_tpu.serve import ReservoirService, ServiceTuner
+from reservoir_tpu.serve.autotune import (
+    DEFAULT_BOUNDS,
+    DEFAULT_KNOBS,
+    KnobBounds,
+    ServiceKnobs,
+    device_kind_of,
+    lookup_knobs,
+    make_serve_key,
+    rate_band,
+    record_knobs,
+    service_fingerprint,
+    zipf_band,
+)
+from reservoir_tpu.utils.faults import FaultPlane, FaultRule
+
+
+def _cfg(**kw):
+    kw.setdefault("max_sample_size", 4)
+    kw.setdefault("num_reservoirs", 8)
+    kw.setdefault("tile_size", 8)
+    return SamplerConfig(**kw)
+
+
+@pytest.fixture
+def cache(tmp_path, monkeypatch):
+    """Point the shared autotune store at a throwaway file."""
+    path = str(tmp_path / "autotune.json")
+    monkeypatch.setenv("RESERVOIR_ALGL_AUTOTUNE_CACHE", path)
+    return path
+
+
+@pytest.fixture
+def registry():
+    reg = obs.enable(obs.Registry())
+    yield reg
+    obs.disable()
+
+
+# --------------------------------------------------------------- the cache
+
+
+class TestBands:
+    def test_rate_band_decades(self):
+        assert rate_band(None) == "any"
+        assert rate_band(0) == "any"
+        assert rate_band(500) == "1e2"
+        assert rate_band(8000) == "1e3"
+        assert rate_band(10_000) == "1e4"
+
+    def test_zipf_band_halves(self):
+        assert zipf_band(None) == "any"
+        assert zipf_band(-1.0) == "any"
+        assert zipf_band(0.3) == "0.5"
+        assert zipf_band(1.1) == "1.0"
+        assert zipf_band(1.3) == "1.5"
+
+    def test_key_shape(self):
+        key = make_serve_key("tpu v5e", 65536, 128, "plain", True, 8000, 1.1)
+        assert key == (
+            "serve|tpu v5e|R=65536|k=128|mode=plain|gated=1"
+            "|rate=1e3|zipf=1.0"
+        )
+
+    def test_unknown_mode_rejected(self):
+        with pytest.raises(ValueError, match="mode"):
+            make_serve_key("cpu", 8, 4, "blorp", False)
+
+
+class TestKnobCache:
+    WINNER = ServiceKnobs(1 << 14, 1 << 22, 256, 0.5, 1 << 16)
+
+    def test_record_lookup_roundtrip(self, cache):
+        key = record_knobs(
+            "cpu", 8, 4, "plain", False, self.WINNER,
+            rate=8000, zipf_s=1.1, elem_per_sec=1e6, source="test",
+        )
+        assert key.startswith("serve|cpu|")
+        got = lookup_knobs("cpu", 8, 4, "plain", False, rate=8000, zipf_s=1.1)
+        assert got == self.WINNER
+
+    def test_any_band_fallback(self, cache):
+        # recorded without a traffic forecast -> served to every band
+        record_knobs("cpu", 8, 4, "plain", False, self.WINNER)
+        got = lookup_knobs("cpu", 8, 4, "plain", False, rate=123, zipf_s=2.0)
+        assert got == self.WINNER
+
+    def test_exact_band_beats_any(self, cache):
+        other = self.WINNER._replace(coalesce_bytes=1 << 15)
+        record_knobs("cpu", 8, 4, "plain", False, self.WINNER)
+        record_knobs("cpu", 8, 4, "plain", False, other, rate=8000, zipf_s=1.1)
+        assert lookup_knobs(
+            "cpu", 8, 4, "plain", False, rate=8000, zipf_s=1.1
+        ) == other
+        assert lookup_knobs("cpu", 8, 4, "plain", False) == self.WINNER
+
+    def test_miss_is_none(self, cache):
+        assert lookup_knobs("cpu", 8, 4, "plain", False) is None
+
+    def test_corrupt_entry_is_none(self, cache):
+        key = make_serve_key("cpu", 8, 4, "plain", False)
+        store.record_raw(key, {"coalesce_bytes": "not a number"}, cache)
+        assert lookup_knobs("cpu", 8, 4, "plain", False) is None
+
+    def test_serve_entries_ride_schema_3(self, cache):
+        record_knobs("cpu", 8, 4, "plain", False, self.WINNER)
+        import json
+
+        with open(cache) as f:
+            raw = json.load(f)
+        assert raw["_schema"] == store._SCHEMA
+
+
+# --------------------------------------------- construction-time consumption
+
+
+class TestConstructionConsumption:
+    def _record_winner(self, knobs=None):
+        knobs = knobs if knobs is not None else TestKnobCache.WINNER
+        record_knobs(device_kind_of(), 8, 4, "plain", False, knobs)
+        return knobs
+
+    def test_cached_winner_consumed(self, cache):
+        winner = self._record_winner()
+        svc = ReservoirService(_cfg(), key=0)
+        live = svc.live_knobs()
+        assert live.coalesce_bytes == winner.coalesce_bytes
+        assert live.max_inflight_bytes == winner.max_inflight_bytes
+        assert live.checkpoint_every == winner.checkpoint_every
+        assert live.gate_push_chunk == winner.gate_push_chunk
+
+    def test_cached_sweep_interval_consumed(self, cache):
+        self._record_winner()
+        svc = ReservoirService(_cfg(), key=0, ttl_s=60.0)
+        assert svc.live_knobs().sweep_interval_s == 0.5
+
+    def test_explicit_kwargs_win(self, cache):
+        winner = self._record_winner()
+        svc = ReservoirService(_cfg(), key=0, coalesce_bytes=1 << 13)
+        live = svc.live_knobs()
+        assert live.coalesce_bytes == 1 << 13  # the kwarg
+        assert live.checkpoint_every == winner.checkpoint_every  # the cache
+
+    def test_empty_cache_means_builtin_defaults(self, cache):
+        svc = ReservoirService(_cfg(), key=0)
+        live = svc.live_knobs()
+        assert live.coalesce_bytes == DEFAULT_KNOBS.coalesce_bytes
+        assert live.max_inflight_bytes == DEFAULT_KNOBS.max_inflight_bytes
+        assert live.checkpoint_every == DEFAULT_KNOBS.checkpoint_every
+
+    def test_fingerprint_matches_lookup_key(self, cache):
+        svc = ReservoirService(_cfg(), key=0)
+        device_kind, R, k, mode, gated = service_fingerprint(svc)
+        assert (R, k, mode, gated) == (8, 4, "plain", False)
+        assert device_kind == device_kind_of()
+
+
+# --------------------------------------------------------- the online tuner
+
+
+def _burn_spec():
+    """quantile 0.9 => budget 0.1: all-bad traffic burns at 10x — past
+    warn (3.0), below page (14.4, unreachable since bad-frac <= 1)."""
+    return obs.SLOSpec(
+        name="ingest_latency_p99",
+        kind="latency_quantile",
+        instrument="serve.ingest_s",
+        threshold=1e-4,
+        quantile=0.9,
+        short_window_s=1.0,
+        long_window_s=1.0,
+    )
+
+
+def _tuned_service(fake, *, fault_times=30, dwell=2, probe_step=0.25,
+                   ttl_s=None):
+    clock = lambda: fake[0]  # noqa: E731
+    plane = obs.SLOPlane([_burn_spec()], clock=clock)
+    fp = FaultPlane([FaultRule(
+        site="serve.ingest", exc=None, delay=0.002, times=fault_times,
+    )])
+    svc = ReservoirService(
+        _cfg(), key=0, ttl_s=ttl_s, faults=fp,
+        coalesce_bytes=DEFAULT_KNOBS.coalesce_bytes,
+        max_inflight_bytes=DEFAULT_KNOBS.max_inflight_bytes,
+        checkpoint_every=DEFAULT_KNOBS.checkpoint_every,
+    )
+    tuner = ServiceTuner(
+        svc, plane, interval_s=1.0, healthy_dwell=dwell,
+        probe_step=probe_step, clock=clock,
+    )
+    svc.open_session("s")
+    return svc, tuner
+
+
+CHUNK = np.arange(16, dtype=np.int32)
+
+
+class TestTunerBackoff:
+    def test_warn_backs_off_within_one_window(self, registry):
+        fake = [0.0]
+        svc, tuner = _tuned_service(fake)
+        before = svc.live_knobs()
+        svc.ingest("s", CHUNK)  # delayed 2ms >> 0.1ms threshold
+        # the ingest hook evaluated at t=0 — inside the very first 1 s
+        # window — saw 100% bad-burn, and retreated immediately
+        assert tuner.backoffs == 1 and len(tuner.decisions) == 1
+        d = tuner.decisions[0]
+        assert d.verdict == "warn" and d.action == "backoff"
+        after = svc.live_knobs()
+        assert after.coalesce_bytes == before.coalesce_bytes // 2
+        assert after.max_inflight_bytes == before.max_inflight_bytes // 2
+        assert after.checkpoint_every == before.checkpoint_every * 2
+
+    def test_frozen_clock_rate_limits_the_hook(self, registry):
+        fake = [0.0]
+        svc, tuner = _tuned_service(fake)
+        for _ in range(5):
+            svc.ingest("s", CHUNK)
+        # one evaluation at t=0; the other four ingests paid one clock
+        # read each (interval_s gating), not a plane evaluation
+        assert len(tuner.decisions) == 1
+
+    def test_inert_knobs_never_touched(self, registry):
+        # no TTL -> no sweep cadence to tune; ungated -> no push chunk
+        fake = [0.0]
+        svc, tuner = _tuned_service(fake, ttl_s=None)
+        before = svc.live_knobs()
+        svc.ingest("s", CHUNK)
+        after = svc.live_knobs()
+        assert after.sweep_interval_s == before.sweep_interval_s
+        assert after.gate_push_chunk == before.gate_push_chunk
+
+    def test_sustained_burn_parks_at_the_bounds(self, registry):
+        fake = [0.0]
+        svc, tuner = _tuned_service(fake, fault_times=10_000)
+        for step in range(12):
+            svc.ingest("s", CHUNK)  # every ingest delayed -> all-bad burn
+            fake[0] = float(step + 1) * 2.0  # next ingest re-evaluates
+        live = svc.live_knobs()
+        lo_c, hi_c = DEFAULT_BOUNDS.coalesce_bytes
+        lo_m, _ = DEFAULT_BOUNDS.max_inflight_bytes
+        _, hi_k = DEFAULT_BOUNDS.checkpoint_every
+        assert live.coalesce_bytes == lo_c  # pinned at the safe end
+        assert live.max_inflight_bytes == lo_m
+        assert live.checkpoint_every == hi_k
+        # once parked, further warns are "hold", not endless backoffs
+        assert tuner.decisions[-1].action == "hold"
+
+    def test_custom_bounds_respected(self, registry):
+        fake = [0.0]
+        clock = lambda: fake[0]  # noqa: E731
+        plane = obs.SLOPlane([_burn_spec()], clock=clock)
+        fp = FaultPlane([FaultRule(
+            site="serve.ingest", exc=None, delay=0.002, times=100,
+        )])
+        svc = ReservoirService(
+            _cfg(), key=0, faults=fp,
+            coalesce_bytes=1 << 16, max_inflight_bytes=1 << 24,
+            checkpoint_every=64,
+        )
+        bounds = KnobBounds(coalesce_bytes=(1 << 15, 1 << 20))
+        tuner = ServiceTuner(
+            svc, plane, interval_s=1.0, clock=clock, bounds=bounds,
+        )
+        svc.open_session("s")
+        for step in range(6):
+            svc.ingest("s", CHUNK)
+            fake[0] = float(step + 1) * 2.0
+        assert svc.live_knobs().coalesce_bytes == 1 << 15
+        assert tuner.backoffs >= 1
+
+    def test_param_validation(self, registry):
+        fake = [0.0]
+        clock = lambda: fake[0]  # noqa: E731
+        plane = obs.SLOPlane([_burn_spec()], clock=clock)
+        svc = ReservoirService(_cfg(), key=0)
+        for bad in (
+            {"backoff_factor": 0.0},
+            {"backoff_factor": 1.0},
+            {"probe_step": 0.0},
+            {"healthy_dwell": 0},
+        ):
+            with pytest.raises(ValueError):
+                ServiceTuner(svc, plane, clock=clock, attach=False, **bad)
+
+
+class TestTunerRecovery:
+    def test_healthy_dwell_reprobes_to_the_optimum(self, registry):
+        fake = [0.0]
+        # probe_step=1.0: one probe restores the optimum exactly, which
+        # makes the recovered state assertable bit-for-bit
+        svc, tuner = _tuned_service(fake, fault_times=1, probe_step=1.0)
+        optimum = tuner.optimum
+        svc.ingest("s", CHUNK)  # the one fault fires: warn -> backoff
+        assert tuner.backoffs == 1
+        backed_off = svc.live_knobs()
+        assert backed_off != optimum
+        # faults exhausted: clean windows accumulate the healthy dwell
+        for step in range(1, 4):
+            fake[0] = float(step) * 2.0
+            svc.ingest("s", CHUNK)
+        assert tuner.probes >= 1
+        assert svc.live_knobs() == optimum
+        # and at the optimum the controller holds, not oscillates
+        fake[0] += 2.0
+        svc.ingest("s", CHUNK)
+        assert tuner.decisions[-1].action == "hold"
+        assert svc.live_knobs() == optimum
+
+    def test_probe_approaches_monotonically_without_overshoot(
+        self, registry
+    ):
+        fake = [0.0]
+        svc, tuner = _tuned_service(fake, fault_times=1, probe_step=0.25)
+        optimum = tuner.optimum
+        svc.ingest("s", CHUNK)
+        seen = [svc.live_knobs().coalesce_bytes]
+        for step in range(1, 12):
+            fake[0] = float(step) * 2.0
+            svc.ingest("s", CHUNK)
+            seen.append(svc.live_knobs().coalesce_bytes)
+        assert all(b >= a for a, b in zip(seen, seen[1:]))
+        assert all(v <= optimum.coalesce_bytes for v in seen)
+        assert seen[-1] > seen[0]  # actually recovering, not parked
+
+    def test_backoff_resets_the_healthy_streak(self, registry):
+        fake = [0.0]
+        svc, tuner = _tuned_service(fake, fault_times=3, dwell=3)
+        svc.ingest("s", CHUNK)  # fault 1: warn at t=0
+        fake[0] = 2.0
+        svc.ingest("s", CHUNK)  # fault 2 still firing: warn again
+        assert all(d.healthy_streak == 0 for d in tuner.decisions)
+        fake[0] = 4.0
+        svc.ingest("s", CHUNK)  # fault 3 (last)
+        fake[0] = 6.0
+        svc.ingest("s", CHUNK)  # clean: streak 1
+        assert tuner.decisions[-1].healthy_streak == 1
+        assert tuner.probes == 0  # dwell=3 not reached yet
+
+
+class TestTunerTelemetry:
+    def test_decisions_land_in_instruments(self, registry):
+        fake = [0.0]
+        svc, tuner = _tuned_service(fake, fault_times=1, probe_step=1.0)
+        svc.ingest("s", CHUNK)
+        for step in range(1, 4):
+            fake[0] = float(step) * 2.0
+            svc.ingest("s", CHUNK)
+        assert tuner.backoffs >= 1 and tuner.probes >= 1
+        assert registry.counter("tune.backoffs").value == tuner.backoffs
+        assert registry.counter("tune.probes").value == tuner.probes
+        live = svc.live_knobs()
+        assert registry.gauge("tune.coalesce_bytes").value == float(
+            live.coalesce_bytes
+        )
+        assert registry.gauge("tune.checkpoint_every").value == float(
+            live.checkpoint_every
+        )
+
+    def test_decision_deque_is_bounded(self, registry):
+        fake = [0.0]
+        clock = lambda: fake[0]  # noqa: E731
+        plane = obs.SLOPlane([_burn_spec()], clock=clock)
+        svc = ReservoirService(_cfg(), key=0)
+        tuner = ServiceTuner(
+            svc, plane, interval_s=0.0, clock=clock, max_decisions=4,
+        )
+        for step in range(10):
+            fake[0] = float(step)
+            tuner.observe()
+        assert len(tuner.decisions) == 4
+
+
+# ------------------------------------------------------- advisory-only proof
+
+
+class TestJournalByteIdentity:
+    def _drive(self, ckdir, with_tuner):
+        """One deterministic service lifetime, journaled to ``ckdir``;
+        optionally with a tuner attached at its optimum (all decisions
+        are 'hold': the plane sees no registry, so every verdict is ok,
+        and probing from the optimum is a no-op)."""
+        svc = ReservoirService(
+            _cfg(), key=3, ttl_s=60.0, checkpoint_dir=ckdir,
+            checkpoint_every=2,
+            coalesce_bytes=DEFAULT_KNOBS.coalesce_bytes,
+            max_inflight_bytes=DEFAULT_KNOBS.max_inflight_bytes,
+        )
+        if with_tuner:
+            fake = [0.0]
+            clock = lambda: fake[0]  # noqa: E731
+            plane = obs.SLOPlane([_burn_spec()], clock=clock)
+            tuner = ServiceTuner(
+                svc, plane, interval_s=0.0, clock=clock,
+            )
+        for i in range(4):
+            svc.open_session(f"s{i}")
+        rng = np.random.default_rng(7)
+        for step in range(12):
+            if with_tuner:
+                fake[0] = float(step)
+            sid = step % 4
+            svc.ingest(f"s{sid}", rng.integers(0, 1 << 20, 64).astype(
+                np.int32
+            ))
+        svc.close_session("s1")
+        svc.sync()
+        svc.shutdown()
+        if with_tuner:
+            # the tuner really ran — and never moved a knob
+            assert len(tuner.decisions) > 0
+            assert tuner.backoffs == 0 and tuner.probes == 0
+
+    def _journal_bytes(self, ckdir):
+        out = {}
+        for name in sorted(os.listdir(ckdir)):
+            path = os.path.join(ckdir, name)
+            if os.path.isfile(path):
+                with open(path, "rb") as f:
+                    out[name] = f.read()
+        return out
+
+    def test_tuner_at_optimum_is_byte_invisible(self, tmp_path):
+        a, b = str(tmp_path / "plain"), str(tmp_path / "tuned")
+        os.makedirs(a), os.makedirs(b)
+        self._drive(a, with_tuner=False)
+        self._drive(b, with_tuner=True)
+        ja, jb = self._journal_bytes(a), self._journal_bytes(b)
+        assert set(ja) == set(jb) and ja, "journals missing"
+        for name in ja:
+            assert ja[name] == jb[name], (
+                f"{name} diverged with a tuner attached at its optimum"
+            )

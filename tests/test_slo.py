@@ -456,6 +456,43 @@ class TestLoadgen:
             assert wait.count == res.offered  # every arrival recorded
             assert res.wait_p99_s >= res.wait_p50_s >= 0.0
 
+    def test_million_session_universe_bounded_memory(self):
+        # ISSUE 14: per-session loadgen state is two flat numpy arrays
+        # (~9 MB at 10^6 sessions) plus bounded key-batch scratch — a
+        # million-key universe must NOT materialize a million resident
+        # Python objects.  The ceiling covers the universe-sized numpy
+        # working set (schedule CDF + permutation + position/live arrays,
+        # ~50 MB at 10^6) with headroom; a dict-of-objects regression
+        # lands far past it.
+        import tracemalloc
+
+        svc = ReservoirService(_cfg(R=16, B=16, k=4), coalesce_bytes=1 << 14)
+        spec = loadgen.LoadSpec(
+            duration_s=0.05,
+            rate=4000.0,
+            sessions=1_000_000,
+            zipf_s=1.1,
+            chunk=16,
+            churn=0.01,
+            snapshot_every=50,
+            seed=4,
+            max_arrivals=200,  # the UNIVERSE is the scaled axis, not load
+        )
+        tracemalloc.start()
+        try:
+            with obs.active():
+                res = loadgen.run_load(svc, spec)
+            _, peak = tracemalloc.get_traced_memory()
+        finally:
+            tracemalloc.stop()
+        assert res.offered > 0 and res.errors == 0
+        assert res.completed + res.rejected == res.offered
+        peak_mb = peak / (1 << 20)
+        assert peak_mb < 96.0, (
+            f"loadgen peaked at {peak_mb:.0f} MiB for a million-session "
+            f"universe"
+        )
+
     def test_corrected_wait_charges_lateness_to_the_service(self):
         # a virtual clock where every ingest costs 50ms against a 1000/s
         # schedule: the service is ~50x oversubscribed, so the corrected

@@ -487,6 +487,47 @@ def test_traffic_config_registered():
     assert env.get("RESERVOIR_BENCH_SELFTEST") == "0"
 
 
+def test_tune_config_registered():
+    # the ISSUE-14 autotuner A/B rides the capture queue, budget-capped
+    # like every other config (traffic-sized plus sweep headroom), with
+    # the parity selftest off (host-path row)
+    assert "tune" in tpu_watch.DEFAULT_CONFIGS.split(",")
+    timeout_s, env = tpu_watch.CONFIG_BUDGETS["tune"]
+    assert 0 < timeout_s <= 900
+    assert env.get("RESERVOIR_BENCH_SELFTEST") == "0"
+
+
+def test_tune_rehearsal_post_step_registered():
+    # the ISSUE-14 tuner post-step: budget-capped, runs the closed-loop
+    # tuner suite (cache consumption, backoff-within-one-window, journal
+    # byte-identity) on the native backend, ahead of recovery_rehearsal
+    # (which stays last)
+    steps = {name: (cmd, timeout, env) for name, cmd, timeout, env in
+             tpu_watch.POST_STEPS}
+    cmd, timeout, env = steps["tune_rehearsal"]
+    assert "tests/test_serve_autotune.py" in cmd
+    assert 0 < timeout <= 900
+    assert env.get("RESERVOIR_TPU_TEST_PLATFORM") == "native"
+    order = [name for name, *_ in tpu_watch.POST_STEPS]
+    assert order.index("tune_rehearsal") < order.index("recovery_rehearsal")
+
+
+def test_scale_probe_post_step_registered():
+    # the ISSUE-14 million-session probe: the full 10^6 universe runs as
+    # a budget-capped post-step (tier-1 smoke scales the universe down),
+    # ahead of recovery_rehearsal (which stays last)
+    steps = {name: (cmd, timeout, env) for name, cmd, timeout, env in
+             tpu_watch.POST_STEPS}
+    cmd, timeout, env = steps["scale_probe"]
+    assert any(c.endswith("bench.py") for c in cmd)
+    assert 0 < timeout <= 900
+    assert env.get("RESERVOIR_BENCH_CONFIG") == "scale"
+    assert env.get("RESERVOIR_BENCH_SCALE_UNIVERSE") == "1000000"
+    assert env.get("RESERVOIR_BENCH_SELFTEST") == "0"
+    order = [name for name, *_ in tpu_watch.POST_STEPS]
+    assert order.index("scale_probe") < order.index("recovery_rehearsal")
+
+
 def test_capture_surfaces_slo_verdicts(tmp_path, monkeypatch):
     # a traffic evidence row carrying SLO verdicts must lift them to the
     # capture row's top level, like geometry/fault_counters/telemetry
@@ -596,7 +637,8 @@ def test_post_step_rehearsal_sequential_gating(tmp_path, monkeypatch):
         "distinct_sweep", "pallas_device_tests", "algl_best_block",
         "serve_soak", "ha_rehearsal", "gated_sweep", "gated_rehearsal",
         "shard_rehearsal", "postmortem_rehearsal", "gate_sweep",
-        "merge_sweep", "migrate_rehearsal", "recovery_rehearsal",
+        "merge_sweep", "migrate_rehearsal", "tune_rehearsal",
+        "scale_probe", "recovery_rehearsal",
     ]
     assert committed == ["3 post-step(s) recorded"]
     rows = [

@@ -57,6 +57,11 @@ if _REPO not in sys.path:
 
 __all__ = ["LoadSpec", "LoadResult", "build_schedule", "run_load", "main"]
 
+#: Arrivals per vectorized session-key batch in :func:`run_load` — the
+#: bound on the transient key working set (one numpy unicode array of
+#: this many entries lives at a time, whatever ``spec.sessions`` is).
+_KEY_BATCH = 4096
+
 
 @dataclasses.dataclass(frozen=True)
 class LoadSpec:
@@ -203,66 +208,86 @@ def run_load(
     churn_draws = rng.random(offsets.size) if spec.churn else None
     res = LoadResult(offered=int(offsets.size))
     reg = obs.get_registry()
-    opened: Dict[str, int] = {}  # key -> next stream position
+    # million-session hot path (ISSUE 14): the per-session state is two
+    # flat numpy arrays indexed by Zipf rank — next stream position and
+    # liveness — not a dict of Python keys, so a sessions=10**6 universe
+    # costs ~9 MB flat instead of a million resident str/int objects
+    # (Sanders et al., arXiv:1610.05141: array-batched, cache-efficient
+    # working sets).  Key strings are generated per _KEY_BATCH arrivals
+    # as one vectorized numpy unicode batch and dropped after use — the
+    # working set stays bounded whatever the universe size.
+    positions = np.zeros(spec.sessions, dtype=np.int64)
+    live = np.zeros(spec.sessions, dtype=np.bool_)
     t0 = clock()
 
-    def _open(key: str, fresh: bool) -> None:
+    def _open(sid: int, key: str, fresh: bool) -> None:
         service.open_session(key)
-        opened[key] = 0
+        positions[sid] = 0
+        live[sid] = True
         if fresh:
             res.opens += 1
         else:
             res.reopens += 1
 
-    for i in range(offsets.size):
-        intended = t0 + float(offsets[i])
-        now = clock()
-        if now < intended:
-            sleep(intended - now)
-        else:
-            res.max_behind_s = max(res.max_behind_s, now - intended)
-        key = f"s{int(sess_idx[i])}"
-        try:
-            if key not in opened:
-                _open(key, fresh=True)
-            pos = opened[key]
-            chunk = np.arange(pos, pos + spec.chunk, dtype=np.int32)
+    for base in range(0, offsets.size, _KEY_BATCH):
+        idx_batch = sess_idx[base : base + _KEY_BATCH]
+        key_batch = np.char.add("s", idx_batch.astype(np.str_))
+        for j in range(idx_batch.size):
+            i = base + j
+            intended = t0 + float(offsets[i])
+            now = clock()
+            if now < intended:
+                sleep(intended - now)
+            else:
+                res.max_behind_s = max(res.max_behind_s, now - intended)
+            sid = int(idx_batch[j])
+            key = str(key_batch[j])
             try:
-                service.ingest(key, chunk)
-            except (UnknownSessionError, StaleSessionError):
-                # the table evicted/recycled this lease under pressure —
-                # a real tenant re-opens and carries on (counted, and the
-                # new lease restarts its canary positions at zero)
-                _open(key, fresh=False)
-                chunk = np.arange(spec.chunk, dtype=np.int32)
-                service.ingest(key, chunk)
-            opened[key] = int(chunk[-1]) + 1
-            res.completed += 1
-            res.elements += spec.chunk
-            if spec.snapshot_every and (
-                res.completed % spec.snapshot_every == 0
-            ):
-                # sync=True: the read-your-writes path — the one the
-                # auditor can judge (and the costlier latency population);
-                # the paired sync=False read feeds the LIVE snapshot
-                # latency + staleness histograms the SLOs watch
-                service.snapshot(key)
-                service.snapshot(key, sync=False)
-                res.snapshots += 1
-            if churn_draws is not None and churn_draws[i] < spec.churn:
+                if not live[sid]:
+                    _open(sid, key, fresh=True)
+                pos = int(positions[sid])
+                chunk = np.arange(pos, pos + spec.chunk, dtype=np.int32)
                 try:
-                    service.close_session(key)
-                    res.closes += 1
+                    service.ingest(key, chunk)
                 except (UnknownSessionError, StaleSessionError):
-                    pass  # already evicted under row pressure
-                opened.pop(key, None)
-        except ServiceSaturated:
-            res.rejected += 1
-        except (SessionIngestError, StaleSessionError, UnknownSessionError):
-            res.errors += 1
-        if reg is not None:
-            # corrected wait: lateness a real open-loop caller would see
-            reg.histogram("loadgen.wait_s").observe(clock() - intended)
+                    # the table evicted/recycled this lease under pressure
+                    # — a real tenant re-opens and carries on (counted,
+                    # and the new lease restarts its canary positions at
+                    # zero)
+                    _open(sid, key, fresh=False)
+                    chunk = np.arange(spec.chunk, dtype=np.int32)
+                    service.ingest(key, chunk)
+                positions[sid] = int(chunk[-1]) + 1
+                res.completed += 1
+                res.elements += spec.chunk
+                if spec.snapshot_every and (
+                    res.completed % spec.snapshot_every == 0
+                ):
+                    # sync=True: the read-your-writes path — the one the
+                    # auditor can judge (and the costlier latency
+                    # population); the paired sync=False read feeds the
+                    # LIVE snapshot latency + staleness histograms the
+                    # SLOs watch
+                    service.snapshot(key)
+                    service.snapshot(key, sync=False)
+                    res.snapshots += 1
+                if churn_draws is not None and churn_draws[i] < spec.churn:
+                    try:
+                        service.close_session(key)
+                        res.closes += 1
+                    except (UnknownSessionError, StaleSessionError):
+                        pass  # already evicted under row pressure
+                    live[sid] = False
+                    positions[sid] = 0
+            except ServiceSaturated:
+                res.rejected += 1
+            except (
+                SessionIngestError, StaleSessionError, UnknownSessionError
+            ):
+                res.errors += 1
+            if reg is not None:
+                # corrected wait: lateness a real open-loop caller sees
+                reg.histogram("loadgen.wait_s").observe(clock() - intended)
     res.wall_s = clock() - t0
     res.achieved_rate = res.completed / res.wall_s if res.wall_s > 0 else 0.0
     if reg is not None:

@@ -237,6 +237,32 @@ def _trace_lines(tel: Optional[dict]) -> list:
     return lines
 
 
+def _tune_lines(tel: Optional[dict]) -> list:
+    """The autotuner panel (ISSUE 14): the live knob vector the online
+    ``ServiceTuner`` last applied, its healthy streak, and the running
+    backoff/probe decision counts — absent entirely when no tuner is
+    attached (the gauges only exist once a decision instrumented)."""
+    gauges = (tel or {}).get("gauges") or {}
+    knobs = {
+        k[len("tune."):]: v
+        for k, v in gauges.items()
+        if k.startswith("tune.") and k != "tune.healthy_streak"
+    }
+    if not knobs:
+        return []
+    counters = (tel or {}).get("counters") or {}
+    lines = ["", (
+        f"tuner: backoffs={counters.get('tune.backoffs', 0):g} "
+        f"probes={counters.get('tune.probes', 0):g} "
+        f"healthy_streak={gauges.get('tune.healthy_streak', 0):g}"
+    )]
+    lines.append(
+        "knobs: "
+        + "  ".join(f"{k}={v:g}" for k, v in sorted(knobs.items()))
+    )
+    return lines
+
+
 def _shard_lines(status: dict) -> list:
     """The per-shard panel (ISSUE 9): one row per shard from a cluster
     heartbeat — alive/epoch/seq/sessions/standby-lag/SLO — plus a banner
@@ -301,6 +327,7 @@ def render(status: dict, prev: Optional[dict] = None) -> str:
         )
     tel = status.get("telemetry")
     lines.extend(_slo_lines(tel))
+    lines.extend(_tune_lines(tel))
     lines.extend(_trace_lines(tel))
     if tel:
         hists = tel.get("histograms", {})
@@ -321,7 +348,12 @@ def render(status: dict, prev: Optional[dict] = None) -> str:
                     f"{_fmt_ms(h['p50']):>12}{_fmt_ms(h['p99']):>12}"
                     f"{_fmt_ms(h['p999']):>12}{_fmt_ms(h['max']):>12}"
                 )
-        gauges = tel.get("gauges", {})
+        # tune.* metrics render in their own panel (_tune_lines) — keep
+        # the catch-all gauge/counter lines free of them
+        gauges = {
+            k: v for k, v in tel.get("gauges", {}).items()
+            if not k.startswith("tune.")
+        }
         if gauges:
             lines.append("")
             lines.append(
@@ -330,7 +362,10 @@ def render(status: dict, prev: Optional[dict] = None) -> str:
                     f"{k}={v:g}" for k, v in sorted(gauges.items())
                 )
             )
-        counters = tel.get("counters", {})
+        counters = {
+            k: v for k, v in tel.get("counters", {}).items()
+            if not k.startswith("tune.")
+        }
         if counters:
             lines.append(
                 "counters: "

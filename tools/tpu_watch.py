@@ -109,6 +109,12 @@ CONFIG_BUDGETS: dict[str, tuple[float, dict[str, str]]] = {
     # vs the host tree asserted in-run; Pallas parity evidence rides the
     # parity_probe post-step, so no embedded selftest here
     "merge": (600.0, {"RESERVOIR_BENCH_SELFTEST": "0"}),
+    # the ISSUE-14 autotuner A/B: offline knob sweep -> defaults-vs-
+    # autotuned on one schedule -> warn-burn backoff/recover cycle, all
+    # asserted in-run; the sweep runs a loadgen pass per candidate, so
+    # the budget is traffic-sized plus headroom; host-path config, no
+    # parity selftest
+    "tune": (700.0, {"RESERVOIR_BENCH_SELFTEST": "0"}),
 }
 
 # r5 priority order (VERDICT r4): parity-attached headline first, then
@@ -118,7 +124,8 @@ CONFIG_BUDGETS: dict[str, tuple[float, dict[str, str]]] = {
 # a CONFIG_BUDGETS row (an unbudgeted config can burn a whole window).
 DEFAULT_CONFIGS = (
     "algl,algl_chunk1024,algl_chunk0,distinct,weighted,stream,bridge,"
-    "bridge_serial,gated,serve,ha,traffic,shards,trace,merge,algl_B4096"
+    "bridge_serial,gated,serve,ha,traffic,shards,trace,merge,tune,"
+    "algl_B4096"
 )
 
 def _now() -> str:
@@ -605,6 +612,39 @@ POST_STEPS: list[tuple[str, list[str], float, dict]] = [
         ],
         900.0,
         {"RESERVOIR_TPU_TEST_PLATFORM": "native"},
+    ),
+    (
+        # tune rehearsal (ISSUE 14): the closed-loop tuner suite — knob
+        # cache round-trip, construction-time consumption, warn-burn
+        # backoff within one window, recovery re-probe, journal
+        # byte-identity — against the real backend, budget-capped like
+        # its siblings
+        "tune_rehearsal",
+        [
+            sys.executable,
+            "-m",
+            "pytest",
+            "tests/test_serve_autotune.py",
+            "-q",
+            "--no-header",
+        ],
+        600.0,
+        {"RESERVOIR_TPU_TEST_PLATFORM": "native"},
+    ),
+    (
+        # scale probe (ISSUE 14): the full 10^6-session universe on the
+        # real backend — the tier-1 smoke run scales the universe down,
+        # so this post-step is where the million-session claim is
+        # actually exercised (sweep sublinearity + loadgen memory
+        # ceiling asserted in-run by the stage itself)
+        "scale_probe",
+        [sys.executable, os.path.join(REPO, "bench.py")],
+        900.0,
+        {
+            "RESERVOIR_BENCH_CONFIG": "scale",
+            "RESERVOIR_BENCH_SCALE_UNIVERSE": "1000000",
+            "RESERVOIR_BENCH_SELFTEST": "0",
+        },
     ),
     (
         # robustness rehearsal (ISSUE 3): auto-checkpoint, kill the bridge
