@@ -1,0 +1,140 @@
+"""reservoir-lint: AST invariant checker for the disciplines the runtime
+tests can only trip-wire (ISSUE 15).
+
+~20k LoC of this codebase is held together by conventions that exist
+only as docstrings and runtime trip-wires: the PR-8 lesson that one ulp
+of host-numpy ``log``/``exp`` forks the Threefry skip chain, the
+one-global-load + ``is None`` zero-overhead gate on every obs/faults/
+trace hot path, the ``faults.SITES`` registry, and lock-guarded mutable
+state in the serving/stream/obs planes.  Each of these is *structural*
+— a property of the code's shape, not its execution — so this package
+checks them statically, with no third-party dependencies and no jax
+import (the pass runs in milliseconds, before any device work).
+
+Run it::
+
+    python -m tools.reservoir_lint            # human output, exit 1 on findings
+    python -m tools.reservoir_lint --json     # machine-readable report
+
+or in-process (the tier-1 gate in ``tests/test_lint.py``)::
+
+    from reservoir_tpu.analysis import run_lint
+    assert run_lint().unsuppressed == []
+
+Rule catalog
+============
+
+``bitexact-no-numpy-transcendentals``
+    ``np.log/exp/log1p/expm1/power`` forbidden in device-path modules
+    (``ops/``, ``stream/gate.py``): numpy and XLA disagree in the final
+    ulps, and one ulp flips the Algorithm-L skip floor and forks the
+    counter-based RNG stream (the PR-8 gate incident).  Host-side ops
+    modules are allowlisted by path
+    (:data:`~reservoir_tpu.analysis.rules_numerics.HOST_ALLOWLIST`).
+
+``zero-overhead-gate``
+    A variable bound from ``obs.registry.get()`` / ``obs.trace.get()`` /
+    ``obs.flight.get()`` may only be used at points dominated by its
+    ``is None`` test (dataflow over the enclosing function body), making
+    the runtime trip-wire's zero-overhead contract statically total.
+    Chained ``get().counter(...)`` and direct ``plane.fire()`` on a held
+    :class:`~reservoir_tpu.utils.faults.FaultPlane` are flagged too.
+
+``fault-site-registry``
+    Every ``fire()``/``FaultRule`` site literal must be a member of
+    ``faults.SITES``; every ``SITES`` entry needs at least one
+    production call site (an entry may name a failure domain with
+    several) and must appear in ``tests/test_faults.py``.
+    :func:`site_inventory` is the API the test imports so the sweep and
+    the linter can never drift.
+
+``instrument-name-grammar``
+    Counter/gauge/histogram name literals must match the
+    ``plane.metric`` grammar; the emitted-name set is cross-checked
+    against what ``tools/reservoir_top.py`` renders and what BENCH.md's
+    "Instrument name catalog" documents — a doc-drift detector, not
+    just a style check.
+
+``guarded-by``
+    In the threading-aware modules, an attribute written under
+    ``with self._lock:`` in any method must never be read or written
+    outside the lock in that class.  ``__init__`` is construction;
+    ``*_locked`` methods are caller-holds-lock helpers; benign races are
+    waived per attribute (see below).
+
+``no-wallclock-in-traced``
+    ``time.time()`` (and friends), ``random.*`` and ``np.random.*`` are
+    forbidden in functions reachable from ``jax.jit`` /
+    ``pl.pallas_call`` / ``shard_map`` bodies — a wallclock or host-RNG
+    read is baked in at trace time or fails tracing.  Host-side callers
+    are unaffected.
+
+Driver-level rules: ``parse-error`` (a scanned file that does not
+parse) and ``suppression-hygiene`` (see below); neither is suppressible.
+
+Suppression syntax
+==================
+
+Findings are silenced inline, and the *reason is part of the syntax*::
+
+    self._hits[site] = hit + 1  # reservoir-lint: disable=guarded-by -- single-writer by protocol
+
+- ``disable=`` takes a comma-separated list of rule ids;
+- the ``-- <reason>`` tail is mandatory — a bare disable is itself a
+  finding (``suppression-hygiene``), so the committed tree carries a
+  one-line justification next to every waived invariant;
+- a comment-only line applies to the next source line;
+- ``guarded-by`` additionally accepts an attribute-level waiver: the
+  suppression on the attribute's ``__init__`` assignment covers every
+  access of that attribute in the class (still listed in the suppressed
+  ledger of each run).
+
+The committed-tree contract (``tests/test_lint.py``, tier-1): **zero
+unsuppressed findings** over ``reservoir_tpu/`` + ``tools/``.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from .core import (  # noqa: F401
+    Finding,
+    LintResult,
+    Project,
+    Rule,
+    default_root,
+    render_human,
+    render_json,
+    run_lint,
+)
+from .rules_faults import FaultSiteRegistryRule, site_inventory  # noqa: F401
+from .rules_gating import ZeroOverheadGateRule
+from .rules_locks import GuardedByRule
+from .rules_names import InstrumentNameRule, emitted_instrument_names  # noqa: F401
+from .rules_numerics import BitexactRule, NoWallclockInTracedRule
+
+__all__ = [
+    "Finding",
+    "LintResult",
+    "Project",
+    "Rule",
+    "run_lint",
+    "render_human",
+    "render_json",
+    "default_root",
+    "all_rules",
+    "site_inventory",
+    "emitted_instrument_names",
+]
+
+
+def all_rules() -> List[Rule]:
+    """One fresh instance of every shipped rule, in catalog order."""
+    return [
+        BitexactRule(),
+        ZeroOverheadGateRule(),
+        FaultSiteRegistryRule(),
+        InstrumentNameRule(),
+        GuardedByRule(),
+        NoWallclockInTracedRule(),
+    ]

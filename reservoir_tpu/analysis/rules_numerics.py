@@ -1,0 +1,251 @@
+"""Numerics rules: bit-exactness of device-path math and trace purity.
+
+``bitexact-no-numpy-transcendentals`` encodes the PR-8 gate incident as a
+static invariant: numpy's ``log``/``exp``/``log1p``/``expm1``/``power``
+differ from XLA's in the final ulps (measured 23-37% of lanes on the CPU
+backend), and one ulp is enough to flip the Algorithm-L skip floor and
+fork the Threefry counter chain — the skip gate had to be rebuilt on the
+jitted CPU backend because of exactly this.  Device-path modules
+(``ops/``, ``stream/gate.py``) must therefore do transcendental math
+through ``jnp`` inside jitted code, never through host numpy.  Host-side
+ops modules (the autotune cache, the geometry tables) are allowlisted by
+path; oracle modules live outside the device path entirely.
+
+``no-wallclock-in-traced`` keeps traced code referentially transparent:
+``time.time()`` (and friends), ``random.*`` and ``np.random.*`` inside a
+function reachable from a ``jax.jit`` / ``pl.pallas_call`` /
+``shard_map`` body either fail tracing outright or — worse — bake a
+trace-time constant into the compiled executable and silently stop
+varying.  Host-side callers are unaffected: only functions reachable
+from a traced root (same-module call graph over plain-name calls,
+unwrapping ``vmap``/``partial`` wrappers) are checked.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set
+
+from .core import (
+    Finding,
+    Project,
+    Rule,
+    SourceFile,
+    dotted,
+)
+
+__all__ = ["BitexactRule", "NoWallclockInTracedRule"]
+
+#: Device-path scope: every module here feeds bits that must reconcile
+#: with the engine's compiled math.
+DEVICE_PATH_PREFIXES = ("reservoir_tpu/ops/",)
+DEVICE_PATH_FILES = ("reservoir_tpu/stream/gate.py",)
+
+#: Host-side modules *inside* the device-path prefixes: pure-host geometry
+#: and cache code with no RNG-adjacent math (oracle/ modules are host by
+#: construction and outside the scope entirely).
+HOST_ALLOWLIST = (
+    "reservoir_tpu/ops/autotune.py",
+    "reservoir_tpu/ops/blocking.py",
+)
+
+_TRANSCENDENTALS = ("log", "exp", "log1p", "expm1", "power")
+
+_NUMPY_NAMES = ("numpy", "np")
+
+
+def _numpy_aliases(tree: ast.AST) -> Set[str]:
+    """Local names bound to the numpy module in this file."""
+    out: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == "numpy":
+                    out.add(a.asname or "numpy")
+    return out
+
+
+class BitexactRule(Rule):
+    id = "bitexact-no-numpy-transcendentals"
+    doc = (
+        "numpy log/exp/log1p/expm1/power are forbidden in device-path "
+        "modules (ops/, stream/gate.py): a one-ulp host-vs-XLA "
+        "difference forks the Threefry skip chain (PR-8 incident)"
+    )
+    hint = (
+        "use jnp.* inside the jitted CPU-backend path instead — numpy "
+        "transcendentals differ from XLA in the final ulps, and one ulp "
+        "flips the Algorithm-L skip floor and forks the counter-based "
+        "RNG stream (the PR-8 gate had to be rebuilt for exactly this); "
+        "host-only modules belong on the HOST_ALLOWLIST"
+    )
+
+    def _in_scope(self, relpath: str) -> bool:
+        if relpath in HOST_ALLOWLIST:
+            return False
+        if relpath in DEVICE_PATH_FILES:
+            return True
+        return any(relpath.startswith(p) for p in DEVICE_PATH_PREFIXES)
+
+    def check(self, project: Project) -> Iterable[Finding]:
+        for src in project.sources:
+            if src.tree is None or not self._in_scope(src.relpath):
+                continue
+            np_names = _numpy_aliases(src.tree)
+            # `from numpy import log` — direct function imports
+            direct: Dict[str, str] = {}
+            for node in ast.walk(src.tree):
+                if isinstance(node, ast.ImportFrom) and node.module == "numpy":
+                    for a in node.names:
+                        if a.name in _TRANSCENDENTALS:
+                            direct[a.asname or a.name] = a.name
+            for node in ast.walk(src.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                fn = node.func
+                name: Optional[str] = None
+                if (isinstance(fn, ast.Attribute)
+                        and fn.attr in _TRANSCENDENTALS
+                        and isinstance(fn.value, ast.Name)
+                        and fn.value.id in np_names):
+                    # only attribute calls on a *numpy* alias are flagged;
+                    # jnp.log is the required spelling, not a violation
+                    name = f"{fn.value.id}.{fn.attr}"
+                elif isinstance(fn, ast.Name) and fn.id in direct:
+                    name = f"numpy.{direct[fn.id]}"
+                if name is not None:
+                    yield Finding(
+                        self.id, src.relpath, node.lineno, node.col_offset,
+                        f"{name} in device-path module {src.relpath}",
+                        hint=self.hint,
+                    )
+
+
+# ------------------------------------------------------------- rule 6
+
+_JIT_WRAPPERS = ("vmap", "partial", "named_call", "remat", "checkpoint",
+                 "grad", "value_and_grad")
+_JIT_ENTRY = ("jit", "pallas_call", "shard_map")
+
+_TIME_FNS = ("time", "time_ns", "monotonic", "monotonic_ns",
+             "perf_counter", "perf_counter_ns")
+
+
+def _callable_args(call: ast.Call) -> Iterable[ast.AST]:
+    """The function-valued argument(s) of a jit-like call, unwrapping
+    wrapper calls like ``jax.jit(jax.vmap(f))``."""
+    for arg in call.args[:1] or call.args:
+        node = arg
+        while isinstance(node, ast.Call):
+            fn = dotted(node.func) or ""
+            leaf = fn.rsplit(".", 1)[-1]
+            if leaf in _JIT_WRAPPERS or leaf in _JIT_ENTRY:
+                if not node.args:
+                    break
+                node = node.args[0]
+            else:
+                break
+        yield node
+
+
+def _is_jit_entry(func: ast.AST) -> bool:
+    name = dotted(func) or ""
+    return name.rsplit(".", 1)[-1] in _JIT_ENTRY
+
+
+class NoWallclockInTracedRule(Rule):
+    id = "no-wallclock-in-traced"
+    doc = (
+        "time.time()/random.*/np.random.* are forbidden in functions "
+        "reachable from jax.jit / pl.pallas_call bodies (a wallclock or "
+        "host-RNG read is baked in at trace time or fails tracing)"
+    )
+    hint = (
+        "traced code must be a pure function of its arguments: thread a "
+        "Threefry key (ops/threefry.py) for randomness and measure wall "
+        "time around the dispatch, not inside the traced body"
+    )
+
+    def check(self, project: Project) -> Iterable[Finding]:
+        for src in project.sources:
+            if src.tree is None:
+                continue
+            yield from self._check_file(src)
+
+    def _check_file(self, src: SourceFile) -> Iterable[Finding]:
+        # every named function in the file, keyed by bare name (duplicate
+        # names union conservatively — the linter over-approximates
+        # reachability rather than missing a traced path)
+        funcs: Dict[str, List[ast.AST]] = {}
+        for node in ast.walk(src.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                funcs.setdefault(node.name, []).append(node)
+
+        roots: List[ast.AST] = []
+
+        def add_root(node: ast.AST) -> None:
+            if isinstance(node, ast.Lambda):
+                roots.append(node)
+            elif isinstance(node, ast.Name) and node.id in funcs:
+                roots.extend(funcs[node.id])
+
+        for node in ast.walk(src.tree):
+            if isinstance(node, ast.Call) and _is_jit_entry(node.func):
+                for target in _callable_args(node):
+                    add_root(target)
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for deco in node.decorator_list:
+                    entry = deco.func if isinstance(deco, ast.Call) else deco
+                    if _is_jit_entry(entry):
+                        roots.append(node)
+                    elif (isinstance(deco, ast.Call)
+                            and (dotted(deco.func) or "").endswith("partial")
+                            and deco.args and _is_jit_entry(deco.args[0])):
+                        roots.append(node)
+
+        # same-module reachability over plain-name calls
+        reachable: List[ast.AST] = []
+        seen: Set[int] = set()
+        frontier = list(roots)
+        while frontier:
+            fn = frontier.pop()
+            if id(fn) in seen:
+                continue
+            seen.add(id(fn))
+            reachable.append(fn)
+            for sub in ast.walk(fn):
+                if isinstance(sub, ast.Call) and isinstance(sub.func, ast.Name):
+                    for callee in funcs.get(sub.func.id, ()):
+                        if id(callee) not in seen:
+                            frontier.append(callee)
+
+        emitted: Set[int] = set()
+        for fn in reachable:
+            for call in ast.walk(fn):
+                if not isinstance(call, ast.Call) or id(call) in emitted:
+                    continue
+                bad = self._banned(call)
+                if bad is not None:
+                    emitted.add(id(call))
+                    owner = getattr(fn, "name", "<lambda>")
+                    yield Finding(
+                        self.id, src.relpath, call.lineno, call.col_offset,
+                        f"{bad} inside traced function {owner!r} "
+                        "(reachable from a jit/pallas_call body)",
+                        hint=self.hint,
+                    )
+
+    @staticmethod
+    def _banned(call: ast.Call) -> Optional[str]:
+        name = dotted(call.func)
+        if name is None:
+            return None
+        parts = name.split(".")
+        if parts[0] == "time" and len(parts) == 2 and parts[1] in _TIME_FNS:
+            return name
+        if parts[0] == "random" and len(parts) == 2:
+            return name
+        if (len(parts) >= 3 and parts[0] in _NUMPY_NAMES
+                and parts[1] == "random"):
+            return name
+        return None

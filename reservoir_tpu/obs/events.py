@@ -65,7 +65,7 @@ class EventLog:
         with self._lock:
             return dict(self._dropped)
 
-    def _admit(self, event: str, now: float) -> bool:
+    def _admit_locked(self, event: str, now: float) -> bool:
         """Token-bucket admission (caller holds the lock)."""
         if self._rate <= 0:
             return True
@@ -95,7 +95,7 @@ class EventLog:
         with self._lock:
             if self._fh.closed:
                 return False
-            if not self._admit(event, now):
+            if not self._admit_locked(event, now):
                 return False
             lines = ""
             if self._dropped:

@@ -76,6 +76,7 @@ class Counter:
 
     def __init__(self, name: str) -> None:
         self.name = name
+        # reservoir-lint: disable=guarded-by -- lock-free .value readout: a single float attribute read is GIL-atomic (exact-or-stale, never torn)
         self._value = 0.0
         self._lock = threading.Lock()
 
@@ -95,6 +96,7 @@ class Gauge:
 
     def __init__(self, name: str) -> None:
         self.name = name
+        # reservoir-lint: disable=guarded-by -- lock-free .value readout: last-write-wins, a single float attribute read is GIL-atomic
         self._value = 0.0
         self._lock = threading.Lock()
 
@@ -147,9 +149,13 @@ class Histogram:
         self._bpd = int(buckets_per_decade)
         self._n = int(math.ceil(math.log10(hi / lo) * buckets_per_decade))
         self._counts = [0] * (self._n + 1)  # +1: overflow (> hi)
+        # reservoir-lint: disable=guarded-by -- lock-free stats readout: per-field reads are GIL-atomic; cross-field skew vs a concurrent observe() is accepted monitoring semantics (quantile() does lock)
         self._count = 0
+        # reservoir-lint: disable=guarded-by -- lock-free stats readout (see _count)
         self._sum = 0.0
+        # reservoir-lint: disable=guarded-by -- lock-free stats readout (see _count)
         self._min = math.inf
+        # reservoir-lint: disable=guarded-by -- lock-free stats readout (see _count)
         self._max = -math.inf
         self._lock = threading.Lock()
 

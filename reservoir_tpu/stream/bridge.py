@@ -154,11 +154,14 @@ class _FlushPipeline:
                 fn = self._fn()
                 if fn is None:  # owner collected: discard remaining work
                     return
-                if self._error is None and not self._wedged:
+                with self._cv:
+                    healthy = self._error is None and not self._wedged
+                if healthy:
                     self._run_one(fn, item)
             except BaseException as e:  # surfaced at next reserve/join...
-                if self._error is None:
-                    self._error = e
+                with self._cv:
+                    if self._error is None:
+                        self._error = e
                 self._fatal(e)  # ...AND on the future, right now
             finally:
                 self._free.release()  # the tile is safe to demux into
@@ -181,9 +184,11 @@ class _FlushPipeline:
                 return
             except BaseException as e:
                 policy = self._retry
+                with self._cv:
+                    wedged = self._wedged
                 if (
                     policy is not None
-                    and not self._wedged
+                    and not wedged
                     and policy.retryable(e)
                     and attempt < policy.max_retries
                 ):
@@ -236,10 +241,12 @@ class _FlushPipeline:
             self._cv.notify_all()
 
     def _check(self) -> None:
-        if self._error is not None:
+        with self._cv:
             err, self._error = self._error, None
+            wedged = self._wedged
+        if err is not None:
             raise err
-        if self._wedged:
+        if wedged:
             # the first caller got the original FlushTimeout above; the
             # pipeline stays unusable (its worker is stuck in the runtime)
             raise FlushTimeout("flush pipeline wedged past its watchdog")
@@ -286,9 +293,10 @@ class _FlushPipeline:
             self._q.put(None)
             with self._cv:
                 self._submitted += 1  # the sentinel is counted when drained
+                wedged = self._wedged
             # a wedged worker is stuck inside a runtime call and may never
             # reach the sentinel — don't block teardown on it
-            self._thread.join(timeout=1.0 if self._wedged else 30)
+            self._thread.join(timeout=1.0 if wedged else 30)
         # An exception raised on the FINAL flush used to be silently lost
         # here when the owner closed without another reserve()/join();
         # close() is a completion barrier and must re-raise it (the

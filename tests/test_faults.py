@@ -205,6 +205,80 @@ def test_all_sites_exercised(tmp_path):
         assert hits.get(site, 0) >= 1, (site, hits)
 
 
+def test_static_site_inventory_matches_runtime_sweep():
+    """The linter's static fire()-site inventory and this file's runtime
+    sweep read the same registry (ISSUE 15): every ``faults.SITES`` entry
+    has at least one production call site, and the static scan knows no
+    site the registry doesn't — so ``test_all_sites_exercised`` above and
+    ``reservoir-lint``'s ``fault-site-registry`` rule can never drift
+    against each other."""
+    from reservoir_tpu.analysis import site_inventory
+
+    inv = site_inventory()
+    assert set(inv) == set(faults.SITES)
+    missing = sorted(s for s, callsites in inv.items() if not callsites)
+    assert not missing, (
+        f"SITES entries with no production fire() call site: {missing}"
+    )
+
+
+def test_bridge_demux_fault_costs_nothing_and_is_bit_exact(tmp_path):
+    """Fault-matrix entry for ``bridge.demux``: the site fires before any
+    element is staged, so a failed ``push()`` costs the producer nothing —
+    retrying the same push yields a stream bit-identical to an un-faulted
+    run — and the plane's hit ledger counts every demux entry."""
+    data = np.arange(48, dtype=np.int32)
+
+    clean = DeviceStreamBridge(_cfg(), key=11)
+    for v in data:
+        clean.push(0, v)
+    want = clean.complete()
+
+    plane = FaultPlane(
+        [FaultRule("bridge.demux", exc=TransientDeviceError, after=3,
+                   times=1, message="injected demux fault")]
+    )
+    bridge = DeviceStreamBridge(_cfg(), key=11, faults=plane)
+    injected = 0
+    for v in data:
+        while True:
+            try:
+                bridge.push(0, v)
+                break
+            except TransientDeviceError:
+                injected += 1  # the failed push staged nothing: retry it
+    got = bridge.complete()
+    assert injected == 1
+    assert plane.hits().get("bridge.demux", 0) >= data.size
+    for a, b in zip(got, want):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_native_staging_fault_fires_on_push_and_drain_paths():
+    """Fault-matrix entry for ``native.staging``: one registry entry names
+    one failure domain with several call sites — the staging buffer fires
+    the site on both the push (``push_chunk``) and drain (``take``) paths,
+    and an injected fault surfaces from whichever path hit it first."""
+    from reservoir_tpu.native import NativeStaging
+    from reservoir_tpu.utils.faults import InjectedFault
+
+    plane = FaultPlane(
+        [FaultRule("native.staging", times=1,
+                   message="injected staging fault")]
+    )
+    with faults.active(plane):
+        st = NativeStaging(2, 8, np.int32)
+        with pytest.raises(InjectedFault):
+            st.push_chunk(0, np.arange(4, dtype=np.int32))
+        # the rule is exhausted: push and drain proceed, each counting a hit
+        assert st.push_chunk(0, np.arange(4, dtype=np.int32)) == 4
+        out = np.zeros(2, np.int32)
+        assert st.take(out) == 4
+        assert out[0] == 4
+    hits = plane.hits()
+    assert hits.get("native.staging", 0) >= 3
+
+
 # ------------------------------------------------------- retry and watchdog
 
 

@@ -1,0 +1,562 @@
+"""Tier-1 gate for reservoir-lint (ISSUE 15).
+
+Two halves:
+
+1. **The committed-tree contract** — the full invariant pass over
+   ``reservoir_tpu/`` + ``tools/`` reports **zero unsuppressed
+   findings**.  Every waiver in the tree carries a reason, so a failure
+   here is always a new violation (or a new rule catching an old one),
+   never noise.
+2. **Self-tests** — for every rule, a synthetic source the rule MUST
+   flag (the positive) and a disciplined variant it must NOT (the
+   negative).  Removing a guard/allowlist entry from the synthetic
+   source flips the verdict, which is exactly the regression the tests
+   pin: the rules keep teeth.
+
+The linter is stdlib-only and must not drag jax in (it runs as the
+tpu_watch pre-step before any device work) — pinned by a fresh-process
+import check below.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+from reservoir_tpu.analysis import (  # noqa: E402
+    all_rules,
+    emitted_instrument_names,
+    render_human,
+    render_json,
+    run_lint,
+    site_inventory,
+)
+from reservoir_tpu.analysis.core import Project  # noqa: E402
+from reservoir_tpu.analysis.rules_faults import FaultSiteRegistryRule  # noqa: E402
+from reservoir_tpu.analysis.rules_gating import ZeroOverheadGateRule  # noqa: E402
+from reservoir_tpu.analysis.rules_locks import GuardedByRule  # noqa: E402
+from reservoir_tpu.analysis.rules_names import InstrumentNameRule  # noqa: E402
+from reservoir_tpu.analysis.rules_numerics import (  # noqa: E402
+    BitexactRule,
+    NoWallclockInTracedRule,
+)
+
+
+def _lint(tmp_path, files, rule):
+    """Write a synthetic tree and run one rule over it."""
+    for rel, text in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(text), encoding="utf-8")
+    return run_lint(root=str(tmp_path), rules=[rule])
+
+
+def _ids(result):
+    return sorted({f.rule for f in result.unsuppressed})
+
+
+# ------------------------------------------------------ the tier-1 contract
+
+
+def test_committed_tree_has_zero_unsuppressed_findings():
+    result = run_lint(root=REPO)
+    assert result.unsuppressed == [], "\n" + render_human(result)
+    # every waiver in the tree carries its reason into the ledger
+    assert all(f.reason for f in result.suppressed)
+
+
+def test_cli_runs_clean_on_the_committed_tree_without_jax():
+    proc = subprocess.run(
+        [sys.executable, "-c",
+         "import sys; import tools.reservoir_lint as rl; "
+         "assert 'jax' not in sys.modules, 'linter imported jax'; "
+         "sys.exit(rl.main(['--json']))"],
+        cwd=REPO, capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    doc = json.loads(proc.stdout)
+    assert doc["summary"]["findings"] == 0
+
+
+def test_json_report_schema_is_pinned():
+    result = run_lint(root=REPO)
+    doc = json.loads(render_json(result))
+    assert set(doc) == {"version", "root", "files", "rules", "findings",
+                        "suppressed", "summary"}
+    assert doc["version"] == 1
+    assert set(doc["summary"]) == {"findings", "suppressed", "by_rule"}
+    assert set(doc["rules"]) == {r.id for r in all_rules()}
+    for entry in doc["suppressed"]:
+        assert {"rule", "file", "line", "col", "message", "hint",
+                "reason"} <= set(entry)
+        assert entry["reason"]
+
+
+def test_cli_exit_codes(tmp_path):
+    env = dict(os.environ, PYTHONPATH=REPO)
+    # unknown rule id -> usage error
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.reservoir_lint", "--rules", "bogus"],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 2
+    assert "unknown rule" in proc.stderr
+    # a tree with a violation -> exit 1
+    bad = tmp_path / "reservoir_tpu" / "ops"
+    bad.mkdir(parents=True)
+    bad.joinpath("k.py").write_text(
+        "import numpy as np\n\ndef f(x):\n    return np.log(x)\n")
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.reservoir_lint",
+         "--root", str(tmp_path)],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 1
+    assert "bitexact-no-numpy-transcendentals" in proc.stdout
+    # --list-rules names the whole catalog
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.reservoir_lint", "--list-rules"],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0
+    for rule in all_rules():
+        assert rule.id in proc.stdout
+
+
+# ------------------------------------------------- rule 1: bitexact numerics
+
+
+def test_bitexact_flags_numpy_transcendentals_in_device_path(tmp_path):
+    result = _lint(tmp_path, {
+        "reservoir_tpu/ops/kernel.py": """
+            import numpy as np
+            from numpy import exp
+
+            def skip_floor(w):
+                return np.log(w)
+
+            def tail(x):
+                return exp(x)
+        """,
+    }, BitexactRule())
+    assert len(result.unsuppressed) == 2
+    assert _ids(result) == ["bitexact-no-numpy-transcendentals"]
+    assert "PR-8" in result.unsuppressed[0].hint
+
+
+def test_bitexact_ignores_jnp_host_modules_and_allowlist(tmp_path):
+    result = _lint(tmp_path, {
+        # jnp is the REQUIRED spelling, never a violation
+        "reservoir_tpu/ops/clean.py": """
+            import jax.numpy as jnp
+
+            def skip_floor(w):
+                return jnp.log(w)
+        """,
+        # same call outside the device path: host code may use numpy
+        "reservoir_tpu/hostside.py": """
+            import numpy as np
+
+            def summarize(x):
+                return np.log(x)
+        """,
+        # allowlisted host module inside ops/
+        "reservoir_tpu/ops/autotune.py": """
+            import numpy as np
+
+            def cost_model(x):
+                return np.log(x)
+        """,
+    }, BitexactRule())
+    assert result.unsuppressed == []
+
+
+# --------------------------------------------------- rule 2: zero-overhead
+
+
+_GATE_BAD = """
+    from .obs import registry as _obs
+
+    def unguarded():
+        reg = _obs.get()
+        reg.counter("serve.ingest_total").inc()
+
+    def chained():
+        _obs.get().counter("serve.ingest_total").inc()
+"""
+
+_GATE_GOOD = """
+    from .obs import registry as _obs
+
+    def guarded():
+        reg = _obs.get()
+        if reg is not None:
+            reg.counter("serve.ingest_total").inc()
+
+    def early_exit():
+        reg = _obs.get()
+        if reg is None:
+            return
+        reg.counter("serve.ingest_total").inc()
+
+    def short_circuit():
+        reg = _obs.get()
+        return reg is not None and reg.counter("a.b").value
+"""
+
+
+def test_gate_rule_flags_unguarded_and_chained_use(tmp_path):
+    result = _lint(tmp_path, {"reservoir_tpu/hot.py": _GATE_BAD},
+                   ZeroOverheadGateRule())
+    assert len(result.unsuppressed) == 2
+    assert _ids(result) == ["zero-overhead-gate"]
+
+
+def test_gate_rule_accepts_the_disciplined_patterns(tmp_path):
+    result = _lint(tmp_path, {"reservoir_tpu/hot.py": _GATE_GOOD},
+                   ZeroOverheadGateRule())
+    assert result.unsuppressed == []
+
+
+def test_gate_rule_flags_direct_fire_on_held_plane(tmp_path):
+    result = _lint(tmp_path, {
+        "reservoir_tpu/hot.py": """
+            from .utils import faults as _faults
+
+            def good(plane):
+                _faults.fire("bridge.demux", plane)
+
+            def bad(plane):
+                plane.fire("bridge.demux")
+        """,
+    }, ZeroOverheadGateRule())
+    assert len(result.unsuppressed) == 1
+    assert "bypasses the" in result.unsuppressed[0].message
+
+
+# ----------------------------------------------- rule 3: fault site registry
+
+
+_FAULTS_DEF = """
+    SITES = ("a.b", "c.d")
+
+    def fire(site, plane=None):
+        pass
+"""
+
+
+def test_fault_registry_flags_unknown_dead_and_untested_sites(tmp_path):
+    result = _lint(tmp_path, {
+        "reservoir_tpu/utils/faults.py": _FAULTS_DEF,
+        "reservoir_tpu/mod.py": """
+            from .utils import faults as _faults
+
+            def go():
+                _faults.fire("a.b")
+                _faults.fire("zz.unknown")
+        """,
+        "tests/test_faults.py": 'SWEEP = ["a.b"]\n',
+    }, FaultSiteRegistryRule())
+    msgs = sorted(f.message for f in result.unsuppressed)
+    assert len(msgs) == 3
+    assert "'zz.unknown' is not in faults.SITES" in msgs[2]
+    assert any("no production fire() call site" in m for m in msgs)  # c.d dead
+    assert any("never appears in tests/test_faults.py" in m for m in msgs)
+
+
+def test_fault_registry_accepts_a_consistent_tree(tmp_path):
+    result = _lint(tmp_path, {
+        "reservoir_tpu/utils/faults.py": _FAULTS_DEF,
+        "reservoir_tpu/mod.py": """
+            from .utils import faults as _faults
+
+            def go():
+                _faults.fire("a.b")
+                _faults.fire("c.d")
+                _faults.fire("a.b")  # several sites per entry are legal
+        """,
+        "tests/test_faults.py": 'SWEEP = ["a.b", "c.d"]\n',
+    }, FaultSiteRegistryRule())
+    assert result.unsuppressed == []
+
+
+def test_site_inventory_api_on_a_synthetic_tree(tmp_path):
+    for rel, text in {
+        "reservoir_tpu/utils/faults.py": _FAULTS_DEF,
+        "reservoir_tpu/mod.py": (
+            "from .utils import faults as _faults\n\n"
+            "def go():\n    _faults.fire('a.b')\n"
+        ),
+    }.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(text))
+    inv = site_inventory(str(tmp_path))
+    assert set(inv) == {"a.b", "c.d"}
+    assert inv["a.b"] == [("reservoir_tpu/mod.py", 4)]
+    assert inv["c.d"] == []
+
+
+# -------------------------------------------- rule 4: instrument name drift
+
+
+def test_name_rule_flags_grammar_render_and_doc_drift(tmp_path):
+    result = _lint(tmp_path, {
+        "reservoir_tpu/m.py": """
+            def f(reg, fast, knob):
+                reg.counter("BadName").inc()
+                reg.gauge("ok.metric").set(1)
+                reg.histogram("x.alpha" if fast else "x.beta").observe(2)
+                reg.gauge(f"dyn.{knob}").set(3)  # dynamic: not a literal
+        """,
+        "tools/reservoir_top.py": 'ROWS = ["ok.metric", "ok.ghost"]\n',
+        "BENCH.md": """
+            # Bench
+
+            ### Instrument name catalog
+
+            `ok.metric` `x.alpha` `x.beta` `doc.stale`
+        """,
+    }, InstrumentNameRule())
+    msgs = sorted(f.message for f in result.unsuppressed)
+    assert len(msgs) == 3
+    assert any("'BadName' does not match" in m for m in msgs)
+    assert any("renders 'ok.ghost'" in m for m in msgs)
+    assert any("catalogs 'doc.stale'" in m for m in msgs)
+    # both IfExp branches counted as emitted, the f-string as nothing
+    project = Project.load(str(tmp_path))
+    names = set(emitted_instrument_names(project))
+    assert {"x.alpha", "x.beta"} <= names
+    assert not any(n.startswith("dyn.") for n in names)
+
+
+def test_name_rule_accepts_a_consistent_tree(tmp_path):
+    result = _lint(tmp_path, {
+        "reservoir_tpu/m.py": """
+            def f(reg):
+                reg.counter("ok.metric").inc()
+        """,
+        "tools/reservoir_top.py": 'ROWS = ["ok.metric"]\n',
+        "BENCH.md": """
+            ### Instrument name catalog
+
+            `ok.metric`
+        """,
+    }, InstrumentNameRule())
+    assert result.unsuppressed == []
+
+
+# ------------------------------------------------------- rule 5: guarded-by
+
+
+_LOCK_PRELUDE = """
+    import threading
+
+    class Box:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._n = 0
+"""
+
+
+def test_guarded_by_flags_unlocked_access(tmp_path):
+    result = _lint(tmp_path, {
+        # must live in a threading-aware module to be in scope
+        "reservoir_tpu/obs/events.py": _LOCK_PRELUDE + """
+        def bump(self):
+            with self._lock:
+                self._n += 1
+
+        def peek(self):
+            return self._n
+
+        def _peek_locked(self):
+            return self._n  # caller-holds-lock helper: skipped
+    """,
+    }, GuardedByRule())
+    assert len(result.unsuppressed) == 1
+    f = result.unsuppressed[0]
+    assert f.rule == "guarded-by"
+    assert "peek()" in f.message
+
+
+def test_guarded_by_accepts_locked_access_and_out_of_scope_modules(tmp_path):
+    clean = _LOCK_PRELUDE + """
+        def bump(self):
+            with self._lock:
+                self._n += 1
+
+        def peek(self):
+            with self._lock:
+                return self._n
+    """
+    racy = _LOCK_PRELUDE + """
+        def bump(self):
+            with self._lock:
+                self._n += 1
+
+        def peek(self):
+            return self._n
+    """
+    result = _lint(tmp_path, {
+        "reservoir_tpu/obs/events.py": clean,
+        # same racy class OUTSIDE the threading-aware set: out of scope
+        "reservoir_tpu/single_threaded.py": racy,
+    }, GuardedByRule())
+    assert result.unsuppressed == []
+
+
+def test_guarded_by_attribute_level_waiver(tmp_path):
+    result = _lint(tmp_path, {
+        "reservoir_tpu/obs/events.py": """
+            import threading
+
+            class Box:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    # reservoir-lint: disable=guarded-by -- monotonic counter, GIL-atomic read
+                    self._n = 0
+
+                def bump(self):
+                    with self._lock:
+                        self._n += 1
+
+                def peek(self):
+                    return self._n
+        """,
+    }, GuardedByRule())
+    assert result.unsuppressed == []
+    assert len(result.suppressed) == 1
+    assert "GIL-atomic" in result.suppressed[0].reason
+
+
+# --------------------------------------------- rule 6: no wallclock in jit
+
+
+def test_wallclock_rule_follows_reachability_from_jit_roots(tmp_path):
+    result = _lint(tmp_path, {
+        "reservoir_tpu/ops/step.py": """
+            import random
+            import time
+
+            import jax
+
+            def helper(x):
+                return x + time.time()
+
+            @jax.jit
+            def step(x):
+                return helper(x)
+
+            noisy = jax.jit(lambda x: x * random.random())
+
+            def host_timer():
+                return time.time()  # host side: fine
+        """,
+    }, NoWallclockInTracedRule())
+    assert len(result.unsuppressed) == 2
+    assert _ids(result) == ["no-wallclock-in-traced"]
+    assert {f.line for f in result.unsuppressed} == {8, 14}
+
+
+def test_wallclock_rule_ignores_untraced_functions(tmp_path):
+    result = _lint(tmp_path, {
+        "reservoir_tpu/ops/step.py": """
+            import time
+
+            def host_only(x):
+                return x + time.time()
+        """,
+    }, NoWallclockInTracedRule())
+    assert result.unsuppressed == []
+
+
+# --------------------------------------- suppression machinery + parse errors
+
+
+def test_suppression_with_reason_moves_finding_to_the_ledger(tmp_path):
+    result = _lint(tmp_path, {
+        "reservoir_tpu/ops/kernel.py": """
+            import numpy as np
+
+            def f(x):
+                return np.log(x)  # reservoir-lint: disable=bitexact-no-numpy-transcendentals -- oracle cross-check, never feeds device bits
+        """,
+    }, BitexactRule())
+    assert result.unsuppressed == []
+    assert len(result.suppressed) == 1
+    assert result.suppressed[0].reason.startswith("oracle cross-check")
+
+
+def test_bare_suppression_is_itself_a_finding(tmp_path):
+    result = _lint(tmp_path, {
+        "reservoir_tpu/ops/kernel.py": """
+            import numpy as np
+
+            def f(x):
+                return np.log(x)  # reservoir-lint: disable=bitexact-no-numpy-transcendentals
+        """,
+    }, BitexactRule())
+    # the reasonless disable suppresses NOTHING and is flagged itself
+    assert _ids(result) == ["bitexact-no-numpy-transcendentals",
+                            "suppression-hygiene"]
+
+
+def test_comment_only_suppression_applies_to_next_line(tmp_path):
+    result = _lint(tmp_path, {
+        "reservoir_tpu/ops/kernel.py": """
+            import numpy as np
+
+            def f(x):
+                # reservoir-lint: disable=bitexact-no-numpy-transcendentals -- host-side estimate feeding a log message only
+                return np.log(x)
+        """,
+    }, BitexactRule())
+    assert result.unsuppressed == []
+    assert len(result.suppressed) == 1
+
+
+def test_unknown_rule_in_suppression_is_flagged(tmp_path):
+    result = _lint(tmp_path, {
+        "reservoir_tpu/ops/kernel.py": """
+            X = 1  # reservoir-lint: disable=no-such-rule -- whatever
+        """,
+    }, BitexactRule())
+    assert _ids(result) == ["suppression-hygiene"]
+    assert "unknown rule id" in result.unsuppressed[0].message
+
+
+def test_syntax_error_is_a_parse_error_finding(tmp_path):
+    result = _lint(tmp_path, {
+        "reservoir_tpu/broken.py": "def f(:\n",
+    }, BitexactRule())
+    assert _ids(result) == ["parse-error"]
+
+
+# ------------------------------------------------------------ ruff gate
+
+
+def test_ruff_check_is_clean():
+    """Tier-1 ruff gate (ISSUE 15 satellite): `ruff check reservoir_tpu
+    tools tests` must pass.  The container image does not bake ruff in,
+    so the gate SKIPS (visibly, not silently passes) when the tool is
+    absent — the moment the environment grows ruff, the gate arms
+    itself with no code change."""
+    import importlib.util
+    import shutil
+
+    import pytest
+
+    if importlib.util.find_spec("ruff") is not None:
+        cmd = [sys.executable, "-m", "ruff"]
+    elif shutil.which("ruff"):
+        cmd = [shutil.which("ruff")]
+    else:
+        pytest.skip("ruff is not installed in this environment")
+    proc = subprocess.run(
+        cmd + ["check", "reservoir_tpu", "tools", "tests"],
+        cwd=REPO, capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stdout + proc.stderr

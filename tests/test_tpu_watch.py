@@ -700,3 +700,60 @@ def test_pseudo_config_env_derivation(config, expect_env, monkeypatch):
     for k, v in expect_env.items():
         assert env.get(k) == v, (k, env.get(k))
     assert env.get("RESERVOIR_BENCH_CONFIG") in ("bridge", "algl")
+
+
+# ----------------------------------------------- lint gate (ISSUE 15)
+
+
+def test_lint_gate_passes_on_the_committed_tree(tmp_path, monkeypatch):
+    """The ISSUE-15 satellite, rehearsed for real: the watcher's static
+    gate runs the actual invariant linter over the actual tree (cheap —
+    stdlib ast, no jax) and must pass on a committed tree.  ruff either
+    runs or is recorded as skipped — never silently absent."""
+    cap = tmp_path / "cap.jsonl"
+    monkeypatch.setattr(tpu_watch, "CAPTURE", str(cap))
+    assert tpu_watch.run_lint_gate() is True
+    recs = [json.loads(line) for line in cap.read_text().splitlines()]
+    names = [r.get("post_step") or r.get("lint_step") for r in recs]
+    assert names[0] == "lint:reservoir_lint"
+    assert recs[0]["rc"] == 0
+    assert any(n in ("lint:ruff", "ruff") for n in names)
+
+
+def test_lint_gate_fails_fast_on_a_dirty_tree(tmp_path, monkeypatch):
+    cap = tmp_path / "cap.jsonl"
+    monkeypatch.setattr(tpu_watch, "CAPTURE", str(cap))
+    steps = [
+        ("boom", [sys.executable, "-c", "import sys; sys.exit(1)"],
+         30.0, True),
+        ("never", [sys.executable, "-c", "print('ran')"], 30.0, True),
+    ]
+    assert tpu_watch.run_lint_gate(steps) is False
+    recs = [json.loads(line) for line in cap.read_text().splitlines()]
+    # fail-fast: the failing step is recorded, the one after it never ran
+    assert [r["post_step"] for r in recs] == ["lint:boom"]
+    assert recs[0]["rc"] == 1
+
+
+def test_lint_gate_records_missing_optional_tool_as_skipped(
+        tmp_path, monkeypatch):
+    cap = tmp_path / "cap.jsonl"
+    monkeypatch.setattr(tpu_watch, "CAPTURE", str(cap))
+    steps = [
+        ("ghost", [sys.executable, "-m", "definitely_not_a_module",
+                   "check"], 30.0, False),
+    ]
+    assert tpu_watch.run_lint_gate(steps) is True
+    rec = json.loads(cap.read_text().splitlines()[0])
+    assert rec["lint_step"] == "ghost"
+    assert rec["rc"] == "skipped"
+
+
+def test_lint_gate_wired_before_the_watch_loop():
+    import inspect
+
+    src = inspect.getsource(tpu_watch.main)
+    assert "run_lint_gate" in src
+    # the gate fires before the first probe: a dirty tree costs seconds,
+    # not a 12-hour watch
+    assert src.index("run_lint_gate") < src.index("probe()")

@@ -383,6 +383,58 @@ def _run_post_step(name: str, cmd: list[str], timeout_s: float, env=None) -> boo
     return rc == 0
 
 
+# Static gates BEFORE any capture (ISSUE 15 satellite): a hardware window
+# must never be burned from a dirty tree — a capture row committed on top
+# of invariant violations is evidence the tier-1 gate rejects anyway.
+# reservoir-lint is stdlib-only (no jax import, runs in well under a
+# second) and is REQUIRED; ruff rides along when the container has it and
+# is recorded as skipped when it doesn't (the image does not bake it in).
+# The bool marks required: a missing required tool fails the gate, a
+# missing optional one records a skip.
+LINT_STEPS: list[tuple[str, list[str], float, bool]] = [
+    (
+        "reservoir_lint",
+        [sys.executable, "-m", "tools.reservoir_lint"],
+        120.0,
+        True,
+    ),
+    (
+        "ruff",
+        [sys.executable, "-m", "ruff", "check",
+         "reservoir_tpu", "tools", "tests"],
+        120.0,
+        False,
+    ),
+]
+
+
+def run_lint_gate(steps=None) -> bool:
+    """Run the static gates, one capture record per step, SEQUENTIAL and
+    fail-fast: the first failure stops the gate and the watcher never
+    reaches ``run_window`` — findings get fixed at a desk, not discovered
+    after a 10-hour tunnel wait.  An optional step whose tool is not
+    importable in this interpreter is recorded as ``skipped``, never
+    silently dropped.  Extracted from ``main`` so the gate can be
+    rehearsed without hardware (``tests/test_tpu_watch.py``)."""
+    import importlib.util
+
+    for name, cmd, timeout_s, required in (
+            LINT_STEPS if steps is None else steps):
+        if not required and cmd[1] == "-m":
+            top = cmd[2].split(".")[0]
+            if importlib.util.find_spec(top) is None:
+                _append({"ts": _now(), "lint_step": name, "rc": "skipped",
+                         "detail": f"{top} not installed"})
+                print(f"[{_now()}] lint-step {name}: skipped "
+                      f"({top} not installed)", flush=True)
+                continue
+        if not _run_post_step(f"lint:{name}", cmd, timeout_s, {}):
+            print(f"[{_now()}] lint-step {name} FAILED — fix the tree "
+                  "before burning a hardware window", flush=True)
+            return False
+    return True
+
+
 # Ordered follow-ups once every bench config is captured: the geometry
 # sweeps (VERDICT r3 item 2a; kernel-parameterized since r7 so the
 # weighted/distinct grids get tuned in the same windows) and the
@@ -720,6 +772,10 @@ def main() -> int:
         help="comma-separated bench configs to capture when the window opens",
     )
     args = ap.parse_args()
+    # the static gate runs before the FIRST probe: a dirty tree fails in
+    # seconds instead of after hours of waiting for a window to open
+    if not run_lint_gate():
+        return 1
     # post steps inherit the run-start stamp so consumers of append-only
     # artifacts (best-block over the sweep file) can ignore records from
     # earlier rounds/runs
